@@ -1,0 +1,44 @@
+"""apex_tpu — a TPU-native training-acceleration framework.
+
+Ground-up JAX/XLA/Pallas re-design with the capabilities of NVIDIA Apex
+(reference: krunt/apex). Package layout mirrors the reference's public surface
+(``reference:apex/__init__.py:7-23``) where that surface is worth keeping:
+
+  - :mod:`apex_tpu.amp`            — mixed-precision policies + loss scaling
+  - :mod:`apex_tpu.optimizers`     — fused Adam/LAMB/SGD/NovoGrad/Adagrad, LARC
+  - :mod:`apex_tpu.normalization`  — fused LayerNorm/RMSNorm (Pallas + XLA)
+  - :mod:`apex_tpu.ops`            — fused softmax, cross-entropy, attention, …
+  - :mod:`apex_tpu.parallel`       — data-parallel grad sync, SyncBatchNorm
+  - :mod:`apex_tpu.transformer`    — Megatron-style TP/PP toolkit on a Mesh
+  - :mod:`apex_tpu.contrib`        — sparsity (ASP), transducer, groupbn, …
+  - :mod:`apex_tpu.utils`          — rank-aware logging, timers, checkpointing
+
+Unlike the reference there are no compiled extensions to feature-detect
+(``reference:apex/__init__.py:13-19``): every op has an XLA path, and Pallas
+kernels are selected by capability flags at call time.
+"""
+
+__version__ = "0.1.0"
+
+from apex_tpu import amp  # noqa: F401
+from apex_tpu.utils.logging import get_logger, setup_logging  # noqa: F401
+
+# Keep heavier subpackages lazily importable: `import apex_tpu` stays cheap,
+# while `apex_tpu.optimizers` etc. resolve on first attribute access.
+import importlib as _importlib
+
+_LAZY_SUBMODULES = (
+    "optimizers", "normalization", "ops", "parallel", "transformer",
+    "contrib", "utils", "fp16_utils", "models", "multi_tensor_apply",
+    "RNN", "reparameterization",
+)
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        return _importlib.import_module(f"apex_tpu.{name}")
+    raise AttributeError(f"module 'apex_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY_SUBMODULES))

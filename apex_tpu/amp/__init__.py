@@ -1,0 +1,40 @@
+"""apex_tpu.amp — mixed-precision policies and loss scaling.
+
+TPU-native re-design of ``reference:apex/amp`` (frontend.py, scaler.py,
+_initialize.py, _process_optimizer.py): instead of monkey-patching torch and
+optimizers, a :class:`Policy` describes the dtypes, and
+:func:`scaled_value_and_grad` threads an on-device loss-scale state through the
+train step. See also ``apex_tpu.fp16_utils`` for the legacy-API shims.
+"""
+
+from apex_tpu.amp.policy import (
+    O0,
+    O1,
+    O2,
+    O3,
+    Policy,
+    cast_floating,
+    cast_to_compute,
+    cast_to_output,
+    cast_to_param,
+    get_policy,
+    with_policy,
+)
+from apex_tpu.amp.scaler import (
+    DynamicLossScale,
+    LossScaleState,
+    NoOpLossScale,
+    StaticLossScale,
+    all_finite,
+    make_loss_scale,
+    scaled_value_and_grad,
+    select_tree,
+)
+
+__all__ = [
+    "Policy", "O0", "O1", "O2", "O3", "get_policy",
+    "cast_to_compute", "cast_to_param", "cast_to_output", "cast_floating",
+    "with_policy",
+    "LossScaleState", "DynamicLossScale", "StaticLossScale", "NoOpLossScale",
+    "make_loss_scale", "all_finite", "select_tree", "scaled_value_and_grad",
+]

@@ -1,0 +1,192 @@
+"""Mixed-precision policies: the TPU-native equivalent of apex.amp opt levels.
+
+The reference implements mixed precision by monkey-patching the torch namespace
+(O1) or casting the model in place and maintaining fp32 master weights behind a
+patched ``optimizer.step`` (O2/O3) — see ``reference:apex/amp/frontend.py:102-191``
+for the O0–O3 policy objects and ``reference:apex/amp/_initialize.py:145-263`` for
+how they are applied.
+
+On TPU none of that machinery is needed: a functional train step lets the policy
+be three dtypes (param / compute / output) plus two flags, applied by tree-mapping
+casts at well-defined boundaries. "Master weights" (O2) are simply fp32 params
+cast to the compute dtype at use; XLA fuses the casts into the consuming ops, so
+there is no separate fp16 weight copy to keep in sync and no state_dict hook is
+needed to save fp32 (params *are* fp32 — cf. ``reference:apex/amp/_initialize.py:133-142``).
+
+The default half dtype on TPU is bfloat16: same exponent range as fp32, so the
+O1/O2 distinction (and most of the loss-scaling machinery) matters mainly for
+float16, which we still support for parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Policy",
+    "O0",
+    "O1",
+    "O2",
+    "O3",
+    "get_policy",
+    "cast_to_compute",
+    "cast_to_param",
+    "cast_to_output",
+    "cast_floating",
+    "with_policy",
+]
+
+def _is_float_array(x: Any) -> bool:
+    # Non-floating leaves (ints, bools, PRNG keys) are left untouched by casts.
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """A mixed-precision policy.
+
+    Mirrors the knobs of ``reference:apex/amp/frontend.py:7-97`` (``Properties``:
+    cast_model_type / patch_torch_functions / keep_batchnorm_fp32 /
+    master_weights / loss_scale), reshaped for a functional framework:
+
+    Attributes:
+      name: display name ("O0".."O3" or custom).
+      param_dtype: dtype in which parameters (and optimizer state) are stored.
+      compute_dtype: dtype in which matmuls/convs run. Casting params to this
+        at use-site is the whole of "O1 patching" on TPU.
+      output_dtype: dtype of model outputs (losses are always accumulated fp32).
+      keep_norms_fp32: run Layer/Batch/RMS norms' reductions and params in fp32
+        (equivalent of ``keep_batchnorm_fp32``).
+      loss_scale: None (no scaling), a float (static scale), or "dynamic".
+    """
+
+    name: str = "O0"
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+    output_dtype: Any = jnp.float32
+    keep_norms_fp32: bool = True
+    loss_scale: Union[None, float, str] = None
+
+    @property
+    def uses_master_weights(self) -> bool:
+        """True when params are stored wider than compute (the O2 pattern)."""
+        return jnp.dtype(self.param_dtype) != jnp.dtype(self.compute_dtype)
+
+    @property
+    def uses_dynamic_scaling(self) -> bool:
+        return self.loss_scale == "dynamic"
+
+    def replace(self, **kw) -> "Policy":
+        return dataclasses.replace(self, **kw)
+
+
+def O0() -> Policy:
+    """Pure fp32 (reference: ``frontend.py:102-118``)."""
+    return Policy(name="O0", param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                  output_dtype=jnp.float32, keep_norms_fp32=True, loss_scale=None)
+
+
+def O1(half_dtype: Any = jnp.bfloat16) -> Policy:
+    """Op-level mixed precision (reference: ``frontend.py:121-143``).
+
+    fp32 params; matmul-class ops in half. On TPU this is the recommended
+    default with bfloat16 (loss scaling unnecessary); with float16 pair it
+    with dynamic loss scaling as the reference does.
+    """
+    scale = "dynamic" if jnp.dtype(half_dtype) == jnp.dtype(jnp.float16) else None
+    return Policy(name="O1", param_dtype=jnp.float32, compute_dtype=half_dtype,
+                  output_dtype=jnp.float32, keep_norms_fp32=True, loss_scale=scale)
+
+
+def O2(half_dtype: Any = jnp.bfloat16) -> Policy:
+    """"Almost half": half model + fp32 master weights (``frontend.py:146-168``).
+
+    Functionally: params stored fp32 (the master copy *is* the param), compute
+    and outputs in half, norms fp32.
+    """
+    scale = "dynamic" if jnp.dtype(half_dtype) == jnp.dtype(jnp.float16) else None
+    return Policy(name="O2", param_dtype=jnp.float32, compute_dtype=half_dtype,
+                  output_dtype=half_dtype, keep_norms_fp32=True, loss_scale=scale)
+
+
+def O3(half_dtype: Any = jnp.bfloat16) -> Policy:
+    """Pure half, speed baseline (``frontend.py:171-191``)."""
+    return Policy(name="O3", param_dtype=half_dtype, compute_dtype=half_dtype,
+                  output_dtype=half_dtype, keep_norms_fp32=False, loss_scale=None)
+
+
+_OPT_LEVELS: dict = {"O0": O0, "O1": O1, "O2": O2, "O3": O3}
+
+
+def get_policy(opt_level: Union[str, Policy], half_dtype: Any = jnp.bfloat16,
+               **overrides) -> Policy:
+    """Resolve an opt-level string to a Policy, applying kwarg overrides.
+
+    Mirrors ``amp.initialize(opt_level=..., **overrides)``
+    (``reference:apex/amp/frontend.py:195-358``): the preset is constructed
+    first, then explicit overrides win.
+    """
+    if isinstance(opt_level, Policy):
+        pol = opt_level
+    else:
+        try:
+            factory = _OPT_LEVELS[opt_level.upper()]
+        except KeyError:
+            raise ValueError(
+                f"Unexpected optimization level {opt_level!r}; options are "
+                "'O0', 'O1', 'O2', 'O3'.") from None
+        pol = factory() if opt_level.upper() == "O0" else factory(half_dtype)
+    if overrides:
+        pol = pol.replace(**overrides)
+    return pol
+
+
+def _cast_tree(tree: Any, dtype: Any) -> Any:
+    dtype = jnp.dtype(dtype)
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype) if _is_float_array(x) else x, tree)
+
+
+def cast_to_compute(tree: Any, policy: Policy) -> Any:
+    """Cast float leaves to the compute dtype (use-site cast of params/inputs)."""
+    return _cast_tree(tree, policy.compute_dtype)
+
+
+def cast_to_param(tree: Any, policy: Policy) -> Any:
+    """Cast float leaves to the param/storage dtype (e.g. grads before update)."""
+    return _cast_tree(tree, policy.param_dtype)
+
+
+def cast_to_output(tree: Any, policy: Policy) -> Any:
+    """Cast float leaves to the output dtype (patched-forward output cast,
+    ``reference:apex/amp/_initialize.py:190-201``)."""
+    return _cast_tree(tree, policy.output_dtype)
+
+
+def cast_floating(tree: Any, dtype: Any) -> Any:
+    """Generic float-leaf cast (equivalent of ``network_to_half`` /
+    ``convert_network``, ``reference:apex/fp16_utils/fp16util.py:35-80``)."""
+    return _cast_tree(tree, dtype)
+
+
+def with_policy(fn: Callable, policy: Policy,
+                cast_inputs: bool = True) -> Callable:
+    """Wrap a functional model apply: params+inputs→compute dtype, outputs→output dtype.
+
+    The functional analog of the patched ``model.forward``
+    (``reference:apex/amp/_initialize.py:190-201``).
+    """
+
+    def wrapped(params, *args, **kwargs):
+        params = cast_to_compute(params, policy)
+        if cast_inputs:
+            args = cast_to_compute(args, policy)
+            kwargs = cast_to_compute(kwargs, policy)
+        out = fn(params, *args, **kwargs)
+        return cast_to_output(out, policy)
+
+    return wrapped

@@ -1,0 +1,222 @@
+"""Loss scaling, fully on-device.
+
+Reference semantics: ``reference:apex/amp/scaler.py:33-217`` — dynamic scale
+starts at 2**16, halves on overflow, doubles after 2000 consecutive clean steps;
+static scaling is a constant multiplier. The reference detects overflow with a
+GPU->CPU ``.item()`` sync every iteration (``scaler.py:199-200``) and skips
+``optimizer.step`` by monkey-patching it (``reference:apex/amp/handle.py:128-154``).
+
+On TPU a host sync per step would stall the XLA pipeline, so the whole protocol
+is expressed as a carried pytree + ``jnp.where``/``lax.cond``: the finite-check
+is a fused reduction over the grad tree, the skip is a select between old and
+new optimizer state. Bitwise-resumable: the state is two scalars, checkpointed
+like any other pytree (cf. ``amp.state_dict``, ``reference:apex/amp/frontend.py:361-400``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "LossScaleState",
+    "DynamicLossScale",
+    "StaticLossScale",
+    "NoOpLossScale",
+    "make_loss_scale",
+    "all_finite",
+    "select_tree",
+    "scaled_value_and_grad",
+]
+
+
+class LossScaleState(NamedTuple):
+    """Carried scaler state: ``(loss_scale, unskipped_steps)``.
+
+    ``unskipped`` mirrors ``LossScaler._unskipped``
+    (``reference:apex/amp/scaler.py:46,203-217``).
+    """
+
+    loss_scale: jnp.ndarray  # f32 scalar
+    unskipped: jnp.ndarray   # i32 scalar
+
+
+def all_finite(tree: Any, axis_names: Union[None, str, Sequence[str]] = None) -> jnp.ndarray:
+    """Single fused bool: every float leaf in ``tree`` is finite.
+
+    The equivalent of the ``noop_flag`` overflow buffer threaded through every
+    ``multi_tensor_apply`` launch (``reference:csrc/multi_tensor_apply.cuh:19-26``,
+    ``reference:apex/amp/scaler.py:94-124``) — except XLA fuses the isfinite
+    reductions into the producing ops, so it costs no extra memory pass.
+
+    When called inside ``shard_map`` with explicit model-parallel axes, pass
+    ``axis_names`` to reduce the flag across the model-parallel group, matching
+    ``transformer.amp.GradScaler`` (``reference:apex/transformer/amp/grad_scaler.py:38-49``).
+    """
+    leaves = [x for x in jax.tree_util.tree_leaves(tree)
+              if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)]
+    if not leaves:
+        finite = jnp.array(True)
+    else:
+        finite = jnp.stack([jnp.all(jnp.isfinite(x)) for x in leaves]).all()
+    if axis_names:
+        if isinstance(axis_names, str):
+            axis_names = (axis_names,)
+        for ax in axis_names:
+            finite = jax.lax.pmin(finite.astype(jnp.int32), ax).astype(jnp.bool_)
+    return finite
+
+
+def select_tree(pred: jnp.ndarray, on_true: Any, on_false: Any) -> Any:
+    """``jnp.where`` over matching pytrees — the on-device "skip step".
+
+    Non-array leaves (Python scalars) are promoted with ``jnp.asarray`` so the
+    select stays traceable under jit.
+    """
+    return jax.tree_util.tree_map(
+        lambda t, f: jax.lax.select(pred, jnp.asarray(t), jnp.asarray(f)),
+        on_true, on_false)
+
+
+@dataclasses.dataclass(frozen=True)
+class DynamicLossScale:
+    """Dynamic loss scaling config (``reference:apex/amp/scaler.py:33-56``).
+
+    init_scale 2**16, doubling every ``growth_interval`` clean steps, halving on
+    overflow; optional min/max clamps mirror ``amp.initialize``'s
+    min_loss_scale/max_loss_scale kwargs (``reference:apex/amp/frontend.py:195-254``).
+    """
+
+    init_scale: float = 2.0 ** 16
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+    min_scale: float = 1.0
+    max_scale: float = 2.0 ** 24
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(
+            loss_scale=jnp.asarray(self.init_scale, jnp.float32),
+            unskipped=jnp.asarray(0, jnp.int32))
+
+    def scale(self, state: LossScaleState, tree: Any) -> Any:
+        s = state.loss_scale
+
+        def _scale(x):
+            x = jnp.asarray(x)
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                return x * s.astype(x.dtype)
+            return x
+
+        return jax.tree_util.tree_map(_scale, tree)
+
+    def unscale(self, state: LossScaleState, grads: Any, cast_to: Any = jnp.float32) -> Any:
+        """fp32 unscale of a (possibly half) grad tree — the functional
+        ``LossScaler.unscale`` (``reference:apex/amp/scaler.py:94-124``):
+        grads are widened to ``cast_to`` *before* multiplying by 1/scale, the
+        master-grad copy semantics of amp O2."""
+        inv = (1.0 / state.loss_scale)
+
+        def _unscale(g):
+            g = jnp.asarray(g)
+            if jnp.issubdtype(g.dtype, jnp.floating):
+                return g.astype(cast_to) * inv
+            return g
+
+        return jax.tree_util.tree_map(_unscale, grads)
+
+    def update(self, state: LossScaleState, grads_finite: jnp.ndarray) -> LossScaleState:
+        """Scale update rule of ``reference:apex/amp/scaler.py:197-217``,
+        branch-free on device."""
+        grew = state.unskipped + 1 >= self.growth_interval
+        scale_if_finite = jnp.where(
+            grew,
+            jnp.minimum(state.loss_scale * self.growth_factor, self.max_scale),
+            state.loss_scale)
+        unskipped_if_finite = jnp.where(grew, 0, state.unskipped + 1)
+        new_scale = jnp.where(
+            grads_finite, scale_if_finite,
+            jnp.maximum(state.loss_scale * self.backoff_factor, self.min_scale))
+        new_unskipped = jnp.where(grads_finite, unskipped_if_finite, 0)
+        return LossScaleState(loss_scale=new_scale,
+                              unskipped=new_unskipped.astype(jnp.int32))
+
+
+@dataclasses.dataclass(frozen=True)
+class StaticLossScale:
+    """Constant loss scale (``reference:apex/fp16_utils/loss_scaler.py:10-44``)."""
+
+    scale: float = 1.0
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(loss_scale=jnp.asarray(self.scale, jnp.float32),
+                              unskipped=jnp.asarray(0, jnp.int32))
+
+    def scale(self, state, tree):
+        return DynamicLossScale.scale(self, state, tree)  # type: ignore[arg-type]
+
+    def unscale(self, state, grads, cast_to=jnp.float32):
+        return DynamicLossScale.unscale(self, state, grads, cast_to)  # type: ignore[arg-type]
+
+    def update(self, state: LossScaleState, grads_finite: jnp.ndarray) -> LossScaleState:
+        return state
+
+
+class NoOpLossScale(StaticLossScale):
+    """Scale of 1 and no overflow checking cost beyond the finite flag."""
+
+    def __init__(self):
+        super().__init__(scale=1.0)
+
+
+def make_loss_scale(spec: Union[None, float, str],
+                    **kwargs) -> Union[DynamicLossScale, StaticLossScale]:
+    """Resolve a ``Policy.loss_scale`` spec ("dynamic" | float | None)."""
+    if spec is None:
+        return NoOpLossScale()
+    if spec == "dynamic":
+        return DynamicLossScale(**kwargs)
+    scale = float(spec)
+    if scale <= 0.0:
+        raise ValueError(f"loss scale must be positive, got {scale}")
+    return StaticLossScale(scale=scale)
+
+
+def scaled_value_and_grad(
+    fun: Callable,
+    loss_scale: Union[DynamicLossScale, StaticLossScale],
+    has_aux: bool = False,
+    axis_names: Union[None, str, Sequence[str]] = None,
+    grad_dtype: Any = jnp.float32,
+):
+    """The functional ``with amp.scale_loss(...) as scaled: scaled.backward()``
+    (``reference:apex/amp/handle.py:16-158``).
+
+    Returns ``step(state, params, *args) -> (value, aux, grads, grads_finite, new_state)``
+    where ``grads`` are unscaled fp32 ("master") grads and ``new_state`` has the
+    scale already adjusted. Callers gate their optimizer update on
+    ``grads_finite`` via :func:`select_tree` — the traced equivalent of the
+    patched skip-step.
+    """
+
+    def step(state: LossScaleState, params: Any, *args, **kwargs):
+        def scaled_fun(p, *a, **k):
+            out = fun(p, *a, **k)
+            if has_aux:
+                value, aux = out
+            else:
+                value, aux = out, None
+            scaled = value.astype(jnp.float32) * state.loss_scale
+            return scaled, (value, aux)
+
+        (_, (value, aux)), grads = jax.value_and_grad(
+            scaled_fun, has_aux=True)(params, *args, **kwargs)
+        grads = loss_scale.unscale(state, grads, cast_to=grad_dtype)
+        finite = all_finite(grads, axis_names=axis_names)
+        new_state = loss_scale.update(state, finite)
+        return value, aux, grads, finite, new_state
+
+    return step

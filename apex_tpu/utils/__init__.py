@@ -1,0 +1,9 @@
+"""apex_tpu.utils — logging, timers, tree utilities, checkpointing."""
+
+from apex_tpu.utils.logging import (  # noqa: F401
+    RankInfoFormatter,
+    get_logger,
+    rank_zero_only,
+    set_verbosity,
+    setup_logging,
+)

@@ -1,0 +1,34 @@
+"""Test configuration: run all tests on a virtual 8-device CPU mesh.
+
+The reference (krunt/apex) requires real GPUs for every test (SURVEY.md §4). We
+improve on that: XLA's CPU backend with ``--xla_force_host_platform_device_count=8``
+lets every distributed code path (DP/TP/PP/SP shardings, collectives, pipeline
+schedules) compile and execute on any host. Real-TPU benchmarking happens in
+``bench.py``, not in the test suite.
+
+Note: the environment may pre-set ``JAX_PLATFORMS`` (e.g. to a TPU plugin) and
+the plugin's sitecustomize may import jax before this conftest runs, so we
+switch platforms via ``jax.config`` — which works any time before the backend
+is first used — rather than via environment variables.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+assert jax.device_count() == 8, (
+    f"tests need 8 virtual CPU devices, got {jax.devices()}; was a backend "
+    "already initialized before conftest ran?")
+
+
+def pytest_report_header(config):
+    return f"jax {jax.__version__} devices: {jax.device_count()} ({jax.default_backend()})"

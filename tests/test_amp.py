@@ -1,0 +1,206 @@
+"""amp policy + loss scaler tests.
+
+Modeled on the reference L0 amp suite (``reference:tests/L0/run_amp/``):
+cast correctness per policy, scaler overflow/growth protocol, skip-step
+semantics, checkpoint round-trip of scaler state.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+
+
+def tree_dtypes(tree):
+    return [x.dtype for x in jax.tree_util.tree_leaves(tree)]
+
+
+class TestPolicy:
+    def test_opt_levels(self):
+        assert amp.get_policy("O0").compute_dtype == jnp.float32
+        o1 = amp.get_policy("O1")
+        assert o1.param_dtype == jnp.float32
+        assert o1.compute_dtype == jnp.bfloat16
+        assert o1.loss_scale is None  # bf16 needs no scaling
+        o2_fp16 = amp.get_policy("O2", half_dtype=jnp.float16)
+        assert o2_fp16.loss_scale == "dynamic"
+        assert o2_fp16.uses_master_weights
+        o3 = amp.get_policy("O3")
+        assert o3.param_dtype == jnp.bfloat16
+        assert not o3.uses_master_weights
+
+    def test_overrides(self):
+        p = amp.get_policy("O2", loss_scale=128.0, keep_norms_fp32=False)
+        assert p.loss_scale == 128.0
+        assert not p.keep_norms_fp32
+
+    def test_bad_level(self):
+        with pytest.raises(ValueError):
+            amp.get_policy("O4")
+
+    def test_cast_skips_non_float(self):
+        tree = {"w": jnp.ones((4, 4), jnp.float32), "step": jnp.asarray(3, jnp.int32)}
+        out = amp.cast_to_compute(tree, amp.get_policy("O1"))
+        assert out["w"].dtype == jnp.bfloat16
+        assert out["step"].dtype == jnp.int32
+
+    def test_with_policy_casts_forward(self):
+        pol = amp.get_policy("O2")
+        seen = {}
+
+        def fn(params, x):
+            seen["param"] = params["w"].dtype
+            seen["x"] = x.dtype
+            return params["w"] @ x
+
+        wrapped = amp.with_policy(fn, pol)
+        out = wrapped({"w": jnp.ones((4, 4), jnp.float32)}, jnp.ones((4,), jnp.float32))
+        assert seen["param"] == jnp.bfloat16
+        assert seen["x"] == jnp.bfloat16
+        assert out.dtype == jnp.bfloat16  # O2 output dtype
+
+
+class TestLossScale:
+    def test_static_noop_update(self):
+        ls = amp.StaticLossScale(128.0)
+        st = ls.init()
+        st2 = ls.update(st, jnp.asarray(False))
+        assert float(st2.loss_scale) == 128.0
+
+    def test_dynamic_backoff_and_growth(self):
+        ls = amp.DynamicLossScale(init_scale=2.0 ** 16, growth_interval=4)
+        st = ls.init()
+        # overflow halves
+        st = ls.update(st, jnp.asarray(False))
+        assert float(st.loss_scale) == 2.0 ** 15
+        assert int(st.unskipped) == 0
+        # growth_interval clean steps doubles
+        for _ in range(4):
+            st = ls.update(st, jnp.asarray(True))
+        assert float(st.loss_scale) == 2.0 ** 16
+        assert int(st.unskipped) == 0
+
+    def test_dynamic_min_clamp(self):
+        ls = amp.DynamicLossScale(init_scale=2.0, min_scale=1.0)
+        st = ls.init()
+        for _ in range(5):
+            st = ls.update(st, jnp.asarray(False))
+        assert float(st.loss_scale) == 1.0
+
+    def test_dynamic_max_clamp(self):
+        ls = amp.DynamicLossScale(init_scale=2.0 ** 24, growth_interval=1,
+                                  max_scale=2.0 ** 24)
+        st = ls.init()
+        st = ls.update(st, jnp.asarray(True))
+        assert float(st.loss_scale) == 2.0 ** 24
+
+    def test_unscale_widens(self):
+        ls = amp.DynamicLossScale(init_scale=4.0)
+        st = ls.init()
+        grads = {"w": jnp.full((3,), 8.0, jnp.float16)}
+        out = ls.unscale(st, grads)
+        assert out["w"].dtype == jnp.float32
+        np.testing.assert_allclose(out["w"], 2.0)
+
+    def test_all_finite(self):
+        good = {"a": jnp.ones(3), "b": jnp.zeros((2, 2))}
+        bad = {"a": jnp.ones(3), "b": jnp.array([1.0, jnp.inf])}
+        nan = {"a": jnp.array([jnp.nan])}
+        assert bool(amp.all_finite(good))
+        assert not bool(amp.all_finite(bad))
+        assert not bool(amp.all_finite(nan))
+        # int leaves ignored
+        assert bool(amp.all_finite({"i": jnp.asarray(2, jnp.int32)}))
+
+    def test_select_tree(self):
+        a = {"x": jnp.ones(2)}
+        b = {"x": jnp.zeros(2)}
+        np.testing.assert_allclose(
+            amp.select_tree(jnp.asarray(True), a, b)["x"], 1.0)
+        np.testing.assert_allclose(
+            amp.select_tree(jnp.asarray(False), a, b)["x"], 0.0)
+
+    def test_make_loss_scale(self):
+        assert isinstance(amp.make_loss_scale(None), amp.NoOpLossScale)
+        assert isinstance(amp.make_loss_scale("dynamic"), amp.DynamicLossScale)
+        s = amp.make_loss_scale(64.0)
+        assert isinstance(s, amp.StaticLossScale) and s.scale == 64.0
+
+
+class TestScaledValueAndGrad:
+    def test_grads_match_unscaled(self):
+        ls = amp.DynamicLossScale(init_scale=2.0 ** 10)
+        params = {"w": jnp.arange(4.0)}
+
+        def loss_fn(p, x):
+            return jnp.sum(p["w"] * x) ** 2
+
+        x = jnp.ones(4)
+        step = amp.scaled_value_and_grad(loss_fn, ls)
+        value, aux, grads, finite, st = step(ls.init(), params, x)
+        ref_grads = jax.grad(loss_fn)(params, x)
+        assert aux is None
+        assert bool(finite)
+        np.testing.assert_allclose(value, loss_fn(params, x), rtol=1e-6)
+        np.testing.assert_allclose(grads["w"], ref_grads["w"], rtol=1e-5)
+
+    def test_overflow_detected_and_scale_lowered(self):
+        # fp16 compute with a big scale: scaled loss overflows fp16 range.
+        ls = amp.DynamicLossScale(init_scale=2.0 ** 16)
+        params = {"w": jnp.full((4,), 1000.0, jnp.float16)}
+
+        def loss_fn(p, x):
+            # keep everything fp16 so the scaled backward overflows
+            return (p["w"] * x).sum(dtype=jnp.float16).astype(jnp.float32)
+
+        step = amp.scaled_value_and_grad(loss_fn, ls)
+        # grads of scaled fp32 loss won't overflow; force it via fp16 cast in fn
+        # -> instead simulate: inf grads from inf loss input
+        x = jnp.full((4,), 60000.0, jnp.float16)  # w*x overflows fp16
+        value, _, grads, finite, st = step(ls.init(), params, x)
+        assert not bool(finite)
+        assert float(st.loss_scale) == 2.0 ** 15
+
+    def test_has_aux(self):
+        ls = amp.StaticLossScale(8.0)
+
+        def loss_fn(p):
+            return jnp.sum(p ** 2), {"n": jnp.asarray(1)}
+
+        step = amp.scaled_value_and_grad(loss_fn, ls, has_aux=True)
+        value, aux, grads, finite, _ = step(ls.init(), jnp.arange(3.0))
+        assert aux["n"] == 1
+        np.testing.assert_allclose(grads, 2 * jnp.arange(3.0), rtol=1e-6)
+
+    def test_jittable_and_skip_step(self):
+        ls = amp.DynamicLossScale(init_scale=2.0 ** 16)
+
+        def loss_fn(p):
+            return jnp.sum(p ** 2)
+
+        step = amp.scaled_value_and_grad(loss_fn, ls)
+
+        @jax.jit
+        def train_step(st, params):
+            value, _, grads, finite, st = step(st, params)
+            new_params = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g,
+                                                params, grads)
+            params = amp.select_tree(finite, new_params, params)
+            return st, params, value
+
+        st = ls.init()
+        params = jnp.arange(4.0)
+        st, params, value = train_step(st, params)
+        np.testing.assert_allclose(params, jnp.arange(4.0) * 0.8, rtol=1e-6)
+
+    def test_scaler_state_checkpoint_roundtrip(self):
+        # the pytree is the state_dict (reference:apex/amp/frontend.py:361-400)
+        ls = amp.DynamicLossScale()
+        st = ls.init()
+        st = ls.update(st, jnp.asarray(False))
+        flat, treedef = jax.tree_util.tree_flatten(st)
+        restored = jax.tree_util.tree_unflatten(treedef, [np.asarray(x) for x in flat])
+        assert float(restored.loss_scale) == float(st.loss_scale)
+        assert int(restored.unskipped) == int(st.unskipped)
