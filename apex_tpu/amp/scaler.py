@@ -108,7 +108,9 @@ class DynamicLossScale:
         def _scale(x):
             x = jnp.asarray(x)
             if jnp.issubdtype(x.dtype, jnp.floating):
-                return x * s.astype(x.dtype)
+                # multiply in f32: the default 2**16 scale overflows fp16's
+                # max (65504) if cast to the leaf dtype first
+                return (x.astype(jnp.float32) * s).astype(x.dtype)
             return x
 
         return jax.tree_util.tree_map(_scale, tree)

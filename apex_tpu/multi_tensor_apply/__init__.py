@@ -1,0 +1,37 @@
+"""Tensor-list ops — the TPU equivalent of ``amp_C``/``multi_tensor_apply``.
+
+The reference batches elementwise kernels over lists of tensors with a chunked
+launcher (``reference:csrc/multi_tensor_apply.cuh:19-133``,
+``reference:apex/multi_tensor_apply/multi_tensor_apply.py:3-34``) because eager
+CUDA pays per-kernel launch overhead. Under XLA one jitted function over a
+pytree compiles to fused loops, so no launcher exists here — we keep the *API*
+shape (an op over a list/tree of tensors plus an overflow flag) and let the
+compiler do the batching.
+
+The ``noop_flag`` overflow buffer becomes a returned boolean: every op that the
+reference guards with the flag returns ``(result, all_finite)`` so callers can
+gate updates with :func:`apex_tpu.amp.select_tree` instead of re-reading a
+device buffer from the host.
+"""
+
+from apex_tpu.multi_tensor_apply.multi_tensor_apply import (  # noqa: F401
+    flatten,
+    multi_tensor_applier,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_scale,
+    tree_global_norm,
+    tree_per_tensor_norms,
+    unflatten,
+)
+
+__all__ = [
+    "flatten",
+    "unflatten",
+    "multi_tensor_scale",
+    "multi_tensor_axpby",
+    "multi_tensor_l2norm",
+    "multi_tensor_applier",
+    "tree_global_norm",
+    "tree_per_tensor_norms",
+]

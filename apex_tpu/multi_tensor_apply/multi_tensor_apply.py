@@ -1,0 +1,123 @@
+"""Batched tensor-list math over pytrees (see package docstring)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from apex_tpu.amp.scaler import all_finite
+
+__all__ = [
+    "flatten", "unflatten", "multi_tensor_scale", "multi_tensor_axpby",
+    "multi_tensor_l2norm", "multi_tensor_applier",
+    "tree_global_norm", "tree_per_tensor_norms",
+]
+
+
+def flatten(tree: Any) -> Tuple[jnp.ndarray, Callable[[jnp.ndarray], Any]]:
+    """Pack a pytree into one fp-contiguous 1-D buffer.
+
+    Equivalent of ``apex_C.flatten`` (``reference:csrc/flatten_unflatten.cpp:15-17``)
+    used for DDP bucket transport; returns the buffer and the inverse.
+    """
+    return ravel_pytree(tree)
+
+
+def unflatten(flat: jnp.ndarray, unravel: Callable[[jnp.ndarray], Any]) -> Any:
+    """Inverse of :func:`flatten` (``apex_C.unflatten``)."""
+    return unravel(flat)
+
+
+def _float_leaves(tree: Any) -> List[jnp.ndarray]:
+    return [x for x in jax.tree_util.tree_leaves(tree)
+            if hasattr(x, "dtype") and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)]
+
+
+def multi_tensor_scale(tree: Any, scale: Any) -> Tuple[Any, jnp.ndarray]:
+    """``out = in * scale`` over every float leaf, plus a finite flag.
+
+    Mirrors ``amp_C.multi_tensor_scale`` (``reference:csrc/multi_tensor_scale_kernel.cu:30``),
+    which is amp's unscale/copy workhorse (``reference:apex/amp/scaler.py:94-124``).
+    The flag is true iff every *output* element is finite.
+    """
+    scale = jnp.asarray(scale, jnp.float32)
+
+    def _scale(x):
+        x = jnp.asarray(x)
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return x
+        return (x.astype(jnp.float32) * scale).astype(x.dtype)
+
+    out = jax.tree_util.tree_map(_scale, tree)
+    return out, all_finite(out)
+
+
+def multi_tensor_axpby(a: Any, x_tree: Any, b: Any, y_tree: Any,
+                       out_dtype: Any = None) -> Tuple[Any, jnp.ndarray]:
+    """``out = a*x + b*y`` leafwise with finite flag.
+
+    Mirrors ``amp_C.multi_tensor_axpby`` (``reference:csrc/multi_tensor_axpby_kernel.cu:28``),
+    used by ``unscale_with_stashed`` (``reference:apex/amp/scaler.py:152-189``).
+    """
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+
+    def _axpby(x, y):
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        out = a * x.astype(jnp.float32) + b * y.astype(jnp.float32)
+        return out.astype(out_dtype or x.dtype)
+
+    out = jax.tree_util.tree_map(_axpby, x_tree, y_tree)
+    return out, all_finite(out)
+
+
+def tree_per_tensor_norms(tree: Any, ord: int = 2) -> Any:
+    """Per-leaf L2 (or L-inf with ``ord=0``) norms in fp32, same treedef."""
+
+    def _norm(x):
+        x = jnp.asarray(x).astype(jnp.float32)
+        if ord == 0:
+            return jnp.max(jnp.abs(x))
+        return jnp.sqrt(jnp.sum(x * x))
+
+    return jax.tree_util.tree_map(_norm, tree)
+
+
+def tree_global_norm(tree: Any) -> jnp.ndarray:
+    """Global L2 norm across every leaf (fp32 accumulation).
+
+    Mirrors ``amp_C.multi_tensor_l2norm``'s global output
+    (``reference:csrc/multi_tensor_l2norm_kernel.cu:29``), which FusedLAMB uses
+    for its global grad-norm clip (``reference:apex/optimizers/fused_lamb.py:124-133``).
+    """
+    leaves = _float_leaves(tree)
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    sq = [jnp.sum(jnp.asarray(x).astype(jnp.float32) ** 2) for x in leaves]
+    return jnp.sqrt(jnp.stack(sq).sum())
+
+
+def multi_tensor_l2norm(tree: Any, per_tensor: bool = False):
+    """``(global_norm,)`` or ``(global_norm, per_tensor_norms)`` like the
+    reference binding's two outputs."""
+    g = tree_global_norm(tree)
+    if per_tensor:
+        return g, tree_per_tensor_norms(tree)
+    return g
+
+
+class _MultiTensorApplier:
+    """API-compat shim for ``multi_tensor_applier(op, noop_flag, lists, *args)``
+    call sites (``reference:apex/multi_tensor_apply/multi_tensor_apply.py:28-34``):
+    here it just calls ``op(*lists, *args)`` — chunking is XLA's job."""
+
+    available = True
+
+    def __call__(self, op, noop_flag_unused, tensor_lists, *args):
+        return op(*tensor_lists, *args)
+
+
+multi_tensor_applier = _MultiTensorApplier()

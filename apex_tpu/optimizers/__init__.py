@@ -1,0 +1,27 @@
+"""Fused-optimizer suite (``reference:apex/optimizers/__init__.py:1-6``).
+
+Pure pytree update functions; XLA fuses each step into a few loops over the
+whole parameter set, which is the TPU analog of the one-kernel
+``multi_tensor_apply`` launches the reference uses.
+"""
+
+from apex_tpu.optimizers._base import OptimizerBase  # noqa: F401
+from apex_tpu.optimizers.fused_adam import (  # noqa: F401
+    AdagradState, AdamState, FusedAdagrad, FusedAdam)
+from apex_tpu.optimizers.fused_lamb import (  # noqa: F401
+    FusedLAMB, FusedMixedPrecisionLamb, LAMBState, MixedPrecisionLambState)
+from apex_tpu.optimizers.fused_novograd import (  # noqa: F401
+    FusedNovoGrad, NovoGradState)
+from apex_tpu.optimizers.fused_sgd import FusedSGD, SGDState  # noqa: F401
+from apex_tpu.optimizers.larc import LARC, larc_transform_grads  # noqa: F401
+
+__all__ = [
+    "OptimizerBase",
+    "FusedAdam", "AdamState",
+    "FusedAdagrad", "AdagradState",
+    "FusedLAMB", "LAMBState",
+    "FusedMixedPrecisionLamb", "MixedPrecisionLambState",
+    "FusedNovoGrad", "NovoGradState",
+    "FusedSGD", "SGDState",
+    "LARC", "larc_transform_grads",
+]

@@ -1,0 +1,96 @@
+"""Shared machinery for the fused-optimizer suite.
+
+The reference optimizers are ``torch.optim.Optimizer`` subclasses whose
+``step`` launches one batched CUDA kernel over the whole parameter set
+(``reference:apex/optimizers/fused_adam.py:90-173`` etc.). On TPU the natural
+shape is a *pure update function over pytrees* that XLA fuses into a handful of
+loops; the class carries only hyperparameters, and all mutable state (step
+count, moments) is an explicit pytree the caller threads through jit.
+
+Every optimizer here follows the same protocol::
+
+    opt = FusedAdam(lr=1e-3)
+    state = opt.init(params)
+    new_params, new_state = opt.step(grads, state, params)
+
+``step`` accepts ``grads_finite`` (a traced bool from
+:func:`apex_tpu.amp.all_finite`) and skips the whole update on overflow via an
+on-device select — the traced equivalent of amp's patched skip-step
+(``reference:apex/amp/handle.py:128-154``). ``lr`` and other schedule-driven
+scalars may be passed per-step to override the constructor value, mirroring
+param-group ``group['lr']`` mutation in torch.
+
+``as_optax()`` adapts any of these to an ``optax.GradientTransformation`` for
+ecosystem interop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import select_tree
+
+__all__ = ["OptimizerBase", "tree_unzip", "tree_zeros_like_f32",
+           "bias_correction"]
+
+
+def tree_unzip(out: Any, treedef) -> Tuple[Any, ...]:
+    """Split a tree whose leaves are k-tuples into k trees of ``treedef``."""
+    leaves = treedef.flatten_up_to(out)
+    k = len(leaves[0])
+    return tuple(treedef.unflatten([l[i] for l in leaves]) for i in range(k))
+
+
+def tree_zeros_like_f32(params: Any) -> Any:
+    """fp32 zeros with the shapes of ``params`` — optimizer state is always
+    fp32 regardless of param dtype, matching the master-state behavior of the
+    reference fused optimizers under amp O2."""
+    return jax.tree_util.tree_map(
+        lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params)
+
+
+def bias_correction(beta: float, step: jnp.ndarray) -> jnp.ndarray:
+    """``1 - beta**t`` as an fp32 traced scalar (t = 1-based step count)."""
+    return 1.0 - jnp.power(jnp.asarray(beta, jnp.float32), step.astype(jnp.float32))
+
+
+class OptimizerBase:
+    """Mixin providing the overflow-skip wrapper and the optax adapter."""
+
+    def init(self, params: Any) -> Any:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _step(self, grads: Any, state: Any, params: Any, **kw) -> Tuple[Any, Any]:
+        raise NotImplementedError  # pragma: no cover - abstract
+
+    def step(self, grads: Any, state: Any, params: Any,
+             grads_finite: Optional[jnp.ndarray] = None, **kw) -> Tuple[Any, Any]:
+        new_params, new_state = self._step(grads, state, params, **kw)
+        if grads_finite is None:
+            return new_params, new_state
+        # Skip = keep old params AND old state (step count does not advance),
+        # exactly like the reference skipping optimizer.step() wholesale.
+        new_params = select_tree(grads_finite, new_params, params)
+        new_state = select_tree(grads_finite, new_state, state)
+        return new_params, new_state
+
+    def as_optax(self):
+        """Expose as an ``optax.GradientTransformationExtraArgs``; the update
+        returns deltas so it composes with optax chains."""
+        import optax
+
+        def init_fn(params):
+            return self.init(params)
+
+        def update_fn(grads, state, params=None, **extra):
+            if params is None:
+                raise ValueError("this transformation requires params")
+            new_params, new_state = self.step(grads, state, params, **extra)
+            updates = jax.tree_util.tree_map(
+                lambda n, p: n - p.astype(n.dtype), new_params, params)
+            return updates, new_state
+
+        return optax.GradientTransformationExtraArgs(init_fn, update_fn)

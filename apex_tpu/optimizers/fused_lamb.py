@@ -1,0 +1,146 @@
+"""FusedLAMB + FusedMixedPrecisionLamb — pytree LAMB matching the reference.
+
+Two-phase structure of ``reference:apex/optimizers/fused_lamb.py:96-213``:
+(1) global grad norm via ``multi_tensor_l2norm`` and clip coefficient
+``clipped = gn/max_grad_norm if gn > max_grad_norm else 1``
+(``reference:csrc/multi_tensor_lamb.cu:66``); (2) per-param Adam-style update
+(``multi_tensor_lamb.cu:120-143``: MOMENT_MODE_0 folds L2 into the scaled grad,
+MOMENT_MODE_1 = AdamW appends ``decay*p`` to the update), then per-tensor trust
+ratio ``lr * ||p||/||update||`` applied only where ``use_nvlamb or decay != 0``
+(``multi_tensor_lamb.cu:244-262``).
+
+FusedMixedPrecisionLamb (``reference:apex/optimizers/fused_mixed_precision_lamb.py:8-255``)
+is the same math driven by fp32 master params with low-precision model params
+regenerated after the step, and a dynamic ``grad_scale`` divisor folded into
+the grad read (kernels ``multi_tensor_l2norm_mp``/``lamb_mp``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor_apply import tree_global_norm
+from apex_tpu.optimizers._base import (
+    OptimizerBase, bias_correction, tree_unzip, tree_zeros_like_f32)
+
+__all__ = ["FusedLAMB", "LAMBState", "FusedMixedPrecisionLamb",
+           "MixedPrecisionLambState"]
+
+
+class LAMBState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: Any
+    exp_avg_sq: Any
+
+
+class FusedLAMB(OptimizerBase):
+    def __init__(self, lr: float = 1e-3, bias_correction: bool = True,
+                 betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-6,
+                 weight_decay: float = 0.01, adam_w_mode: bool = True,
+                 grad_averaging: bool = True, max_grad_norm: float = 1.0,
+                 use_nvlamb: bool = False, amsgrad: bool = False):
+        if amsgrad:
+            raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
+        self.lr = lr
+        self.use_bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adam_w_mode = adam_w_mode
+        self.grad_averaging = grad_averaging
+        self.max_grad_norm = max_grad_norm
+        self.use_nvlamb = use_nvlamb
+
+    def init(self, params: Any) -> LAMBState:
+        return LAMBState(step=jnp.asarray(0, jnp.int32),
+                         exp_avg=tree_zeros_like_f32(params),
+                         exp_avg_sq=tree_zeros_like_f32(params))
+
+    def _step(self, grads: Any, state: LAMBState, params: Any,
+              lr: Optional[Any] = None,
+              weight_decay: Optional[Any] = None,
+              grad_scale: Any = 1.0) -> Tuple[Any, LAMBState]:
+        lr = jnp.asarray(self.lr if lr is None else lr, jnp.float32)
+        wd = jnp.asarray(
+            self.weight_decay if weight_decay is None else weight_decay,
+            jnp.float32)
+        inv_gs = 1.0 / jnp.asarray(grad_scale, jnp.float32)
+        t = state.step + 1
+        if self.use_bias_correction:
+            bc1, bc2 = bias_correction(self.beta1, t), bias_correction(self.beta2, t)
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+        b1, b2, eps = self.beta1, self.beta2, self.eps
+        beta3 = (1.0 - b1) if self.grad_averaging else 1.0
+
+        # Phase 1: global grad-norm clip coefficient (fused_lamb.py:124-133).
+        gnorm = tree_global_norm(grads) * inv_gs
+        clip = jnp.where(gnorm > self.max_grad_norm,
+                         gnorm / self.max_grad_norm, 1.0)
+
+        def _update(g, p, m, v):
+            p32 = jnp.asarray(p).astype(jnp.float32)
+            sg = jnp.asarray(g).astype(jnp.float32) * inv_gs / clip
+            if not self.adam_w_mode:  # MOMENT_MODE_0: L2 on scaled grad
+                sg = sg + wd * p32
+            m = b1 * m + beta3 * sg
+            v = b2 * v + (1.0 - b2) * sg * sg
+            update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if self.adam_w_mode:  # MOMENT_MODE_1
+                update = update + wd * p32
+            # Stage 2: per-tensor trust ratio (multi_tensor_lamb.cu:244-262).
+            pnorm = jnp.sqrt(jnp.sum(p32 * p32))
+            unorm = jnp.sqrt(jnp.sum(update * update))
+            ratio = jnp.where((pnorm != 0.0) & (unorm != 0.0),
+                              lr * pnorm / unorm, lr)
+            if not self.use_nvlamb:
+                # trust ratio only for decayed params
+                ratio = jnp.where(wd != 0.0, ratio, lr)
+            new_p = p32 - ratio * update
+            return new_p.astype(jnp.asarray(p).dtype), m, v
+
+        out = jax.tree_util.tree_map(
+            _update, grads, params, state.exp_avg, state.exp_avg_sq)
+        new_params, new_m, new_v = tree_unzip(
+            out, jax.tree_util.tree_structure(params))
+        return new_params, LAMBState(step=t, exp_avg=new_m, exp_avg_sq=new_v)
+
+
+class MixedPrecisionLambState(NamedTuple):
+    step: jnp.ndarray
+    master_params: Any  # fp32
+    exp_avg: Any
+    exp_avg_sq: Any
+
+
+class FusedMixedPrecisionLamb(OptimizerBase):
+    """LAMB over fp32 masters with low-precision model params regenerated
+    after each step; ``grad_scale`` (the live loss scale) divides grads inside
+    the update so callers can feed *scaled* grads directly
+    (``reference:apex/optimizers/fused_mixed_precision_lamb.py:140-255``)."""
+
+    def __init__(self, **lamb_kwargs):
+        self._lamb = FusedLAMB(**lamb_kwargs)
+
+    def init(self, params: Any) -> MixedPrecisionLambState:
+        master = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(p).astype(jnp.float32), params)
+        inner = self._lamb.init(params)
+        return MixedPrecisionLambState(
+            step=inner.step, master_params=master,
+            exp_avg=inner.exp_avg, exp_avg_sq=inner.exp_avg_sq)
+
+    def _step(self, grads: Any, state: MixedPrecisionLambState, params: Any,
+              lr: Optional[Any] = None, grad_scale: Any = 1.0
+              ) -> Tuple[Any, MixedPrecisionLambState]:
+        inner_state = LAMBState(state.step, state.exp_avg, state.exp_avg_sq)
+        new_master, new_inner = self._lamb._step(
+            grads, inner_state, state.master_params, lr=lr, grad_scale=grad_scale)
+        new_params = jax.tree_util.tree_map(
+            lambda mp, p: mp.astype(jnp.asarray(p).dtype), new_master, params)
+        return new_params, MixedPrecisionLambState(
+            step=new_inner.step, master_params=new_master,
+            exp_avg=new_inner.exp_avg, exp_avg_sq=new_inner.exp_avg_sq)

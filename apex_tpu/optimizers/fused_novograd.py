@@ -1,0 +1,103 @@
+"""FusedNovoGrad — per-layer second-moment NovoGrad.
+
+Reference: ``reference:apex/optimizers/fused_novograd.py:4-213`` +
+``reference:csrc/multi_tensor_novograd.cu:96-127``. The second moment is one
+scalar *norm* per tensor (not squared; ``fused_novograd.py:157-176``), blended
+``v = beta2*v + (1-beta2)*||g||`` with ``norm_type`` 2 (L2) or 0 (L-inf); if
+``init_zero`` is false the first step seeds ``v = ||g||`` so the first blend is
+a no-op. MOMENT_MODE_0 (``reg_inside_moment``) normalizes+decays the grad
+before the momentum blend; MOMENT_MODE_1 (default) is decoupled.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._base import (
+    OptimizerBase, bias_correction, tree_unzip, tree_zeros_like_f32)
+
+__all__ = ["FusedNovoGrad", "NovoGradState"]
+
+
+class NovoGradState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: Any    # momentum, fp32, per-element
+    exp_avg_sq: Any # norm EMA, fp32, one scalar per tensor
+
+
+class FusedNovoGrad(OptimizerBase):
+    def __init__(self, lr: float = 1e-3, bias_correction: bool = True,
+                 betas: Tuple[float, float] = (0.95, 0.98), eps: float = 1e-8,
+                 weight_decay: float = 0.0, reg_inside_moment: bool = False,
+                 grad_averaging: bool = True, norm_type: int = 2,
+                 init_zero: bool = False, amsgrad: bool = False):
+        if amsgrad:
+            raise RuntimeError("FusedNovoGrad does not support the AMSGrad variant.")
+        if norm_type not in (0, 2):
+            raise RuntimeError("FusedNovoGrad only supports l2/inf norm.")
+        self.lr = lr
+        self.use_bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.reg_inside_moment = reg_inside_moment
+        self.grad_averaging = grad_averaging
+        self.norm_type = norm_type
+        self.init_zero = init_zero
+
+    def init(self, params: Any) -> NovoGradState:
+        return NovoGradState(
+            step=jnp.asarray(0, jnp.int32),
+            exp_avg=tree_zeros_like_f32(params),
+            exp_avg_sq=jax.tree_util.tree_map(
+                lambda p: jnp.zeros((), jnp.float32), params))
+
+    def _grad_norm(self, g32):
+        if self.norm_type == 0:
+            return jnp.max(jnp.abs(g32))
+        return jnp.sqrt(jnp.sum(g32 * g32))
+
+    def _step(self, grads: Any, state: NovoGradState, params: Any,
+              lr: Optional[Any] = None) -> Tuple[Any, NovoGradState]:
+        lr = jnp.asarray(self.lr if lr is None else lr, jnp.float32)
+        wd = jnp.asarray(self.weight_decay, jnp.float32)
+        t = state.step + 1
+        if self.use_bias_correction:
+            bc1 = bias_correction(self.beta1, t)
+            # v is an EMA of *norms*, so its correction carries a sqrt
+            # (reference:csrc/multi_tensor_novograd.cu:151)
+            bc2 = jnp.sqrt(bias_correction(self.beta2, t))
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+        b1, b2, eps = self.beta1, self.beta2, self.eps
+        beta3 = (1.0 - b1) if self.grad_averaging else 1.0
+        first = state.step == 0
+
+        def _update(g, p, m, v):
+            p32 = jnp.asarray(p).astype(jnp.float32)
+            g32 = jnp.asarray(g).astype(jnp.float32)
+            gn = self._grad_norm(g32)
+            if self.init_zero:
+                new_v = b2 * v + (1.0 - b2) * gn
+            else:
+                # first step seeds v = ||g|| so the blend is identity
+                new_v = jnp.where(first, gn, b2 * v + (1.0 - b2) * gn)
+            denom = new_v / bc2 + eps
+            if self.reg_inside_moment:  # MOMENT_MODE_0
+                gg = g32 / denom + wd * p32
+                m = b1 * m + beta3 * gg
+                new_p = p32 - lr * (m / bc1)
+            else:  # MOMENT_MODE_1
+                m = b1 * m + beta3 * g32
+                update = (m / bc1) / denom + wd * p32
+                new_p = p32 - lr * update
+            return new_p.astype(jnp.asarray(p).dtype), m, new_v
+
+        out = jax.tree_util.tree_map(
+            _update, grads, params, state.exp_avg, state.exp_avg_sq)
+        new_params, new_m, new_v = tree_unzip(
+            out, jax.tree_util.tree_structure(params))
+        return new_params, NovoGradState(step=t, exp_avg=new_m, exp_avg_sq=new_v)

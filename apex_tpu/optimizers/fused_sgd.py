@@ -1,0 +1,82 @@
+"""FusedSGD — momentum SGD matching ``reference:csrc/multi_tensor_sgd_kernel.cu``.
+
+Semantics (``multi_tensor_sgd_kernel.cu:87-130``): grads are pre-multiplied by
+``scale``; weight decay is applied before momentum unless
+``wd_after_momentum``; first application seeds the momentum buffer with the
+(decayed) grad; ``nesterov`` uses ``g + momentum*buf``. Python surface:
+``reference:apex/optimizers/fused_sgd.py:6-226``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._base import (
+    OptimizerBase, tree_unzip, tree_zeros_like_f32)
+
+__all__ = ["FusedSGD", "SGDState"]
+
+
+class SGDState(NamedTuple):
+    step: jnp.ndarray      # i32; step==0 means momentum buffers are unseeded
+    momentum_buf: Any      # fp32
+
+
+class FusedSGD(OptimizerBase):
+    """``materialize_master_grads`` is accepted for reference API compat but is
+    a no-op here: there is no separate fp16-grad/fp32-master-grad wiring to
+    choose between — grads are widened to fp32 inside the update
+    (cf. ``reference:apex/optimizers/fused_sgd.py:100-226``)."""
+
+    def __init__(self, lr: float = 1e-3, momentum: float = 0.0,
+                 dampening: float = 0.0, weight_decay: float = 0.0,
+                 nesterov: bool = False, wd_after_momentum: bool = False,
+                 materialize_master_grads: bool = True):
+        if nesterov and (momentum <= 0 or dampening != 0):
+            raise ValueError(
+                "Nesterov momentum requires a momentum and zero dampening")
+        self.lr = lr
+        self.momentum = momentum
+        self.dampening = dampening
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+        self.wd_after_momentum = wd_after_momentum
+        self.materialize_master_grads = materialize_master_grads
+
+    def init(self, params: Any) -> SGDState:
+        return SGDState(step=jnp.asarray(0, jnp.int32),
+                        momentum_buf=tree_zeros_like_f32(params))
+
+    def _step(self, grads: Any, state: SGDState, params: Any,
+              lr: Optional[Any] = None,
+              scale: Any = 1.0) -> Tuple[Any, SGDState]:
+        lr = jnp.asarray(self.lr if lr is None else lr, jnp.float32)
+        scale = jnp.asarray(scale, jnp.float32)
+        wd = jnp.asarray(self.weight_decay, jnp.float32)
+        mom, damp = self.momentum, self.dampening
+        first_run = state.step == 0
+
+        def _update(g, p, buf):
+            p32 = jnp.asarray(p).astype(jnp.float32)
+            g32 = jnp.asarray(g).astype(jnp.float32) * scale
+            if not self.wd_after_momentum:
+                g32 = g32 + wd * p32
+            if mom != 0.0:
+                # first_run seeds buf = g (multi_tensor_sgd_kernel.cu:110-113)
+                seeded = jnp.where(first_run, g32, mom * buf + (1.0 - damp) * g32)
+                step_dir = g32 + mom * seeded if self.nesterov else seeded
+                buf = seeded
+            else:
+                step_dir = g32
+            if self.wd_after_momentum:
+                step_dir = step_dir + wd * p32
+            new_p = p32 - lr * step_dir
+            return new_p.astype(jnp.asarray(p).dtype), buf
+
+        out = jax.tree_util.tree_map(_update, grads, params, state.momentum_buf)
+        new_params, new_buf = tree_unzip(
+            out, jax.tree_util.tree_structure(params))
+        return new_params, SGDState(step=state.step + 1, momentum_buf=new_buf)

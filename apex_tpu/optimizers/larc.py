@@ -1,0 +1,81 @@
+"""LARC — layer-wise adaptive rate clipping as a grad transform.
+
+Reference: ``reference:apex/parallel/LARC.py:5-107``. The torch version wraps
+an optimizer and mutates ``p.grad`` in ``step``:
+``adaptive_lr = trust_coefficient * ||p|| / (||g|| + ||p||*wd + eps)``; with
+``clip=True`` it becomes ``min(adaptive_lr/lr, 1)``; weight decay is absorbed
+into the grad and zeroed on the inner optimizer. Here the same transform is a
+pure function over (grads, params) applied before any inner optimizer step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._base import OptimizerBase
+
+__all__ = ["LARC", "larc_transform_grads"]
+
+
+def larc_transform_grads(grads: Any, params: Any, lr: Any,
+                         trust_coefficient: float = 0.02,
+                         clip: bool = True, eps: float = 1e-8,
+                         weight_decay: Any = 0.0) -> Any:
+    """Per-tensor LARC grad rewrite (``reference:apex/parallel/LARC.py:78-104``)."""
+    lr = jnp.asarray(lr, jnp.float32)
+    wd = jnp.asarray(weight_decay, jnp.float32)
+
+    def _one(g, p):
+        g32 = jnp.asarray(g).astype(jnp.float32)
+        p32 = jnp.asarray(p).astype(jnp.float32)
+        pn = jnp.sqrt(jnp.sum(p32 * p32))
+        gn = jnp.sqrt(jnp.sum(g32 * g32))
+        adaptive_lr = trust_coefficient * pn / (gn + pn * wd + eps)
+        if clip:
+            adaptive_lr = jnp.minimum(adaptive_lr / lr, 1.0)
+        # the reference leaves the grad completely untouched (no decay either)
+        # when either norm is zero (LARC.py:92 'if param_norm != 0 and ...')
+        active = (pn != 0.0) & (gn != 0.0)
+        new_g = jnp.where(active, (g32 + wd * p32) * adaptive_lr, g32)
+        return new_g.astype(jnp.asarray(g).dtype)
+
+    return jax.tree_util.tree_map(_one, grads, params)
+
+
+class LARC(OptimizerBase):
+    """Wrapper: LARC grad transform + inner optimizer with its decay disabled,
+    mirroring the weight-decay absorption of ``LARC.step``."""
+
+    def __init__(self, optimizer: OptimizerBase, trust_coefficient: float = 0.02,
+                 clip: bool = True, eps: float = 1e-8):
+        self.optim = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+
+    def init(self, params: Any) -> Any:
+        return self.optim.init(params)
+
+    def _step(self, grads: Any, state: Any, params: Any,
+              lr: Optional[Any] = None, **kw) -> Tuple[Any, Any]:
+        eff_lr = self.optim.lr if lr is None else lr
+        wd = getattr(self.optim, "weight_decay", 0.0)
+        grads = larc_transform_grads(
+            grads, params, eff_lr, self.trust_coefficient, self.clip,
+            self.eps, weight_decay=wd)
+        # inner optimizer runs with weight decay absorbed (LARC.py:81-85,105-107)
+        import inspect
+        if "weight_decay" in inspect.signature(self.optim._step).parameters:
+            return self.optim._step(grads, state, params, lr=lr,
+                                    weight_decay=0.0, **kw)
+        saved = getattr(self.optim, "weight_decay", None)
+        if saved is None:
+            return self.optim._step(grads, state, params, lr=lr, **kw)
+        self.optim.weight_decay = 0.0
+        try:
+            return self.optim._step(grads, state, params, lr=lr, **kw)
+        finally:
+            self.optim.weight_decay = saved
