@@ -108,9 +108,11 @@ class DynamicLossScale:
         def _scale(x):
             x = jnp.asarray(x)
             if jnp.issubdtype(x.dtype, jnp.floating):
-                # multiply in f32: the default 2**16 scale overflows fp16's
-                # max (65504) if cast to the leaf dtype first
-                return (x.astype(jnp.float32) * s).astype(x.dtype)
+                # widen sub-f32 dtypes for the multiply: the default 2**16
+                # scale overflows fp16's max (65504) if cast to fp16 first;
+                # f64 leaves keep their precision via promote_types
+                wide = jnp.promote_types(x.dtype, jnp.float32)
+                return (x.astype(wide) * s.astype(wide)).astype(x.dtype)
             return x
 
         return jax.tree_util.tree_map(_scale, tree)

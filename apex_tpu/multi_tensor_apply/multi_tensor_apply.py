@@ -101,8 +101,8 @@ def tree_global_norm(tree: Any) -> jnp.ndarray:
 
 
 def multi_tensor_l2norm(tree: Any, per_tensor: bool = False):
-    """``(global_norm,)`` or ``(global_norm, per_tensor_norms)`` like the
-    reference binding's two outputs."""
+    """``global_norm`` scalar, or ``(global_norm, per_tensor_norms)`` when
+    ``per_tensor=True`` (the reference binding's optional second output)."""
     g = tree_global_norm(tree)
     if per_tensor:
         return g, tree_per_tensor_norms(tree)
@@ -112,7 +112,15 @@ def multi_tensor_l2norm(tree: Any, per_tensor: bool = False):
 class _MultiTensorApplier:
     """API-compat shim for ``multi_tensor_applier(op, noop_flag, lists, *args)``
     call sites (``reference:apex/multi_tensor_apply/multi_tensor_apply.py:28-34``):
-    here it just calls ``op(*lists, *args)`` — chunking is XLA's job."""
+    it calls ``op(*tensor_lists, *args)`` — chunking is XLA's job.
+
+    Note this serves *custom* functional ops whose signature takes one
+    positional arg per tensor list. The reference's in-place ``amp_C`` call
+    shapes (e.g. ``[grads, out]`` output lists, ``reference:apex/amp/scaler.py:114-124``)
+    have no functional equivalent here — use :func:`multi_tensor_scale` /
+    :func:`multi_tensor_axpby` / :func:`multi_tensor_l2norm` directly, which
+    return their outputs instead of writing into an out-list.
+    """
 
     available = True
 

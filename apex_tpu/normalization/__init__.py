@@ -1,0 +1,22 @@
+"""Fused normalization (``reference:apex/normalization/__init__.py:1``)."""
+
+from apex_tpu.normalization.fused_layer_norm import (  # noqa: F401
+    FusedLayerNorm,
+    FusedRMSNorm,
+    MixedFusedLayerNorm,
+    MixedFusedRMSNorm,
+    fused_layer_norm,
+    fused_layer_norm_affine,
+    fused_rms_norm,
+    fused_rms_norm_affine,
+    mixed_dtype_fused_layer_norm_affine,
+    mixed_dtype_fused_rms_norm_affine,
+)
+
+__all__ = [
+    "FusedLayerNorm", "FusedRMSNorm",
+    "MixedFusedLayerNorm", "MixedFusedRMSNorm",
+    "fused_layer_norm", "fused_layer_norm_affine",
+    "fused_rms_norm", "fused_rms_norm_affine",
+    "mixed_dtype_fused_layer_norm_affine", "mixed_dtype_fused_rms_norm_affine",
+]

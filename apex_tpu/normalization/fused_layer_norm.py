@@ -1,0 +1,246 @@
+"""Fused LayerNorm / RMSNorm — functional API + lightweight modules.
+
+Reference surface: ``reference:apex/normalization/fused_layer_norm.py`` —
+autograd Functions over the CUDA kernels (:32-119), module classes
+``FusedLayerNorm`` (:204), ``FusedRMSNorm`` (:300), mixed-dtype Megatron
+variants ``MixedFusedLayerNorm``/``MixedFusedRMSNorm`` (:398,420). Dtype
+rules verified against ``reference:csrc/layer_norm_cuda.cpp``: the standard
+affine path requires input/weight dtypes to match and outputs input dtype
+(:183-189), while the ``*_mixed_dtypes`` path allows them to differ and
+outputs **weight** dtype (:205 ``empty_like(input, gamma.options())``);
+stats (mean, invvar) are always fp32 for half inputs (:161,184).
+
+Two implementations sit behind one ``custom_vjp``: a Pallas kernel
+(:mod:`apex_tpu.normalization._pallas`) when the backend is TPU and shapes
+are tile-aligned, else plain jnp that XLA fuses. This replaces the
+import-try feature detection of the reference (``fused_layer_norm.py:15-30``).
+"""
+
+from __future__ import annotations
+
+import functools
+import numbers
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.normalization import _pallas
+
+__all__ = [
+    "fused_layer_norm", "fused_layer_norm_affine",
+    "fused_rms_norm", "fused_rms_norm_affine",
+    "mixed_dtype_fused_layer_norm_affine", "mixed_dtype_fused_rms_norm_affine",
+    "FusedLayerNorm", "FusedRMSNorm", "MixedFusedLayerNorm", "MixedFusedRMSNorm",
+]
+
+
+def _norm_shape(normalized_shape) -> Tuple[int, ...]:
+    if isinstance(normalized_shape, numbers.Integral):
+        return (int(normalized_shape),)
+    return tuple(int(d) for d in normalized_shape)
+
+
+# ---------------------------------------------------------------------------
+# core: custom_vjp per (rms, eps, out_dtype, use_pallas) configuration
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _make_core(rms: bool, eps: float, out_dtype_name: str, use_pallas: bool,
+               has_weight: bool, has_bias: bool):
+    out_dtype = jnp.dtype(out_dtype_name)
+
+    def _xla_fwd(x2d, weight, bias):
+        xf = x2d.astype(jnp.float32)
+        if rms:
+            ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+            invvar = jax.lax.rsqrt(ms + eps)
+            mean = jnp.zeros_like(invvar)
+            xhat = xf * invvar
+        else:
+            mean = jnp.mean(xf, axis=-1, keepdims=True)
+            c = xf - mean
+            var = jnp.mean(c * c, axis=-1, keepdims=True)
+            invvar = jax.lax.rsqrt(var + eps)
+            xhat = c * invvar
+        out = xhat
+        if has_weight:
+            out = out * weight.astype(jnp.float32)
+        if has_bias:
+            out = out + bias.astype(jnp.float32)
+        return out.astype(out_dtype), mean, invvar
+
+    def fwd_impl(x2d, weight, bias):
+        if use_pallas:
+            return _pallas.ln_fwd(x2d, weight if has_weight else None,
+                                  bias if has_bias else None,
+                                  eps=eps, rms=rms, out_dtype=out_dtype)
+        return _xla_fwd(x2d, weight, bias)
+
+    def bwd_impl(dy, x2d, mean, invvar, weight):
+        w_dtype = weight.dtype if has_weight else None
+        if use_pallas:
+            return _pallas.ln_bwd(dy, x2d, mean, invvar,
+                                  weight if has_weight else None,
+                                  rms=rms, has_bias=has_bias,
+                                  x_dtype=x2d.dtype, w_dtype=w_dtype)
+        dyf = dy.astype(jnp.float32)
+        xf = x2d.astype(jnp.float32)
+        xhat = xf * invvar if rms else (xf - mean) * invvar
+        dxhat = dyf * weight.astype(jnp.float32) if has_weight else dyf
+        m2 = jnp.mean(dxhat * xhat, axis=-1, keepdims=True)
+        if rms:
+            dx = invvar * (dxhat - xhat * m2)
+        else:
+            m1 = jnp.mean(dxhat, axis=-1, keepdims=True)
+            dx = invvar * (dxhat - m1 - xhat * m2)
+        dw = jnp.sum(dyf * xhat, axis=0).astype(w_dtype) if has_weight else None
+        db = jnp.sum(dyf, axis=0).astype(w_dtype) if has_bias else None
+        return dx.astype(x2d.dtype), dw, db
+
+    @jax.custom_vjp
+    def core(x2d, weight, bias):
+        return fwd_impl(x2d, weight, bias)[0]
+
+    def core_fwd(x2d, weight, bias):
+        out, mean, invvar = fwd_impl(x2d, weight, bias)
+        return out, (x2d, mean, invvar, weight)
+
+    def core_bwd(res, dy):
+        x2d, mean, invvar, weight = res
+        dx, dw, db = bwd_impl(dy, x2d, mean, invvar, weight)
+        return (dx,
+                dw if has_weight else jnp.zeros((), jnp.float32),
+                db if has_bias else jnp.zeros((), jnp.float32))
+
+    core.defvjp(core_fwd, core_bwd)
+    return core
+
+
+def _run(x, weight, bias, normalized_shape, eps, rms, out_dtype,
+         use_pallas: Optional[bool]):
+    shape = _norm_shape(normalized_shape)
+    h = 1
+    for d in shape:
+        h *= d
+    if tuple(x.shape[-len(shape):]) != shape:
+        raise ValueError(
+            f"normalized_shape {shape} does not match input tail {x.shape}")
+    lead = x.shape[:-len(shape)]
+    n = 1
+    for d in lead:
+        n *= d
+    x2d = x.reshape(n, h)
+    if use_pallas is None:
+        use_pallas = _pallas.supports_pallas(n, h)
+    core = _make_core(rms, float(eps), jnp.dtype(out_dtype).name,
+                      bool(use_pallas), weight is not None, bias is not None)
+    w2 = weight.reshape(h) if weight is not None else jnp.zeros((), jnp.float32)
+    b2 = bias.reshape(h) if bias is not None else jnp.zeros((), jnp.float32)
+    out = core(x2d, w2, b2)
+    return out.reshape(*lead, *shape)
+
+
+# ---------------------------------------------------------------------------
+# functional API (mirrors the autograd Function entry points)
+# ---------------------------------------------------------------------------
+
+def fused_layer_norm_affine(x, weight, bias, normalized_shape, eps=1e-5,
+                            use_pallas: Optional[bool] = None):
+    """``FusedLayerNormAffineFunction`` (``fused_layer_norm.py:32-56``):
+    output dtype = input dtype."""
+    return _run(x, weight, bias, normalized_shape, eps, rms=False,
+                out_dtype=x.dtype, use_pallas=use_pallas)
+
+
+def fused_layer_norm(x, normalized_shape, eps=1e-5,
+                     use_pallas: Optional[bool] = None):
+    """Non-affine LN (``fused_layer_norm.py:122-142``)."""
+    return _run(x, None, None, normalized_shape, eps, rms=False,
+                out_dtype=x.dtype, use_pallas=use_pallas)
+
+
+def fused_rms_norm_affine(x, weight, normalized_shape, eps=1e-5,
+                          use_pallas: Optional[bool] = None):
+    """``FusedRMSNormAffineFunction`` (``fused_layer_norm.py:59-81``)."""
+    return _run(x, weight, None, normalized_shape, eps, rms=True,
+                out_dtype=x.dtype, use_pallas=use_pallas)
+
+
+def fused_rms_norm(x, normalized_shape, eps=1e-5,
+                   use_pallas: Optional[bool] = None):
+    return _run(x, None, None, normalized_shape, eps, rms=True,
+                out_dtype=x.dtype, use_pallas=use_pallas)
+
+
+def mixed_dtype_fused_layer_norm_affine(x, weight, bias, normalized_shape,
+                                        eps=1e-5,
+                                        use_pallas: Optional[bool] = None):
+    """Megatron-compat mixed-dtype LN: output dtype = **weight** dtype
+    (``reference:csrc/layer_norm_cuda.cpp:205``)."""
+    return _run(x, weight, bias, normalized_shape, eps, rms=False,
+                out_dtype=weight.dtype, use_pallas=use_pallas)
+
+
+def mixed_dtype_fused_rms_norm_affine(x, weight, normalized_shape, eps=1e-5,
+                                      use_pallas: Optional[bool] = None):
+    return _run(x, weight, None, normalized_shape, eps, rms=True,
+                out_dtype=weight.dtype, use_pallas=use_pallas)
+
+
+# ---------------------------------------------------------------------------
+# module-style classes (param factories; functional apply)
+# ---------------------------------------------------------------------------
+
+class FusedLayerNorm:
+    """``apex.normalization.FusedLayerNorm`` (``fused_layer_norm.py:204-297``)
+    as a param-factory: ``params = m.init()``, ``y = m(params, x)``."""
+
+    rms = False
+    mixed = False
+
+    def __init__(self, normalized_shape, eps: float = 1e-5,
+                 elementwise_affine: bool = True, param_dtype=jnp.float32):
+        self.normalized_shape = _norm_shape(normalized_shape)
+        self.eps = eps
+        self.elementwise_affine = elementwise_affine
+        self.param_dtype = param_dtype
+
+    @property
+    def _has_bias(self) -> bool:
+        return not self.rms
+
+    def init(self, key: Optional[jax.Array] = None) -> dict:
+        if not self.elementwise_affine:
+            return {}
+        params = {"weight": jnp.ones(self.normalized_shape, self.param_dtype)}
+        if self._has_bias:
+            params["bias"] = jnp.zeros(self.normalized_shape, self.param_dtype)
+        return params
+
+    def __call__(self, params: dict, x, use_pallas: Optional[bool] = None):
+        w = params.get("weight") if self.elementwise_affine else None
+        b = params.get("bias") if (self.elementwise_affine and self._has_bias) else None
+        out_dtype = (w.dtype if (self.mixed and w is not None) else x.dtype)
+        return _run(x, w, b, self.normalized_shape, self.eps, rms=self.rms,
+                    out_dtype=out_dtype, use_pallas=use_pallas)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self.normalized_shape}, eps={self.eps}, "
+                f"elementwise_affine={self.elementwise_affine})")
+
+
+class FusedRMSNorm(FusedLayerNorm):
+    """``fused_layer_norm.py:300-395`` — no bias term."""
+    rms = True
+
+
+class MixedFusedLayerNorm(FusedLayerNorm):
+    """``fused_layer_norm.py:398-417`` — fp32 params with half inputs;
+    output takes the weight dtype."""
+    mixed = True
+
+
+class MixedFusedRMSNorm(FusedRMSNorm):
+    """``fused_layer_norm.py:420-437``."""
+    mixed = True

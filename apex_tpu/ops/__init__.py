@@ -1,0 +1,29 @@
+"""Fused ops: softmax, attention, losses, dense blocks.
+
+TPU equivalents of the reference's kernel-backed op layer
+(``reference:apex/transformer/functional/``, ``apex/contrib/xentropy``,
+``apex/contrib/focal_loss``, ``apex/contrib/fmha``,
+``apex/contrib/multihead_attn``, ``apex/mlp``, ``apex/fused_dense``).
+"""
+
+from apex_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention, mha_reference, supports_flash)
+from apex_tpu.ops.focal_loss import FocalLoss, focal_loss  # noqa: F401
+from apex_tpu.ops.fused_softmax import (  # noqa: F401
+    AttnMaskType, FusedScaleMaskSoftmax, scaled_masked_softmax,
+    scaled_upper_triang_masked_softmax)
+from apex_tpu.ops.mlp import (  # noqa: F401
+    MLP, FusedDense, FusedDenseGeluDense, fused_dense,
+    fused_dense_gelu_dense, mlp_forward)
+from apex_tpu.ops.xentropy import (  # noqa: F401
+    SoftmaxCrossEntropyLoss, softmax_cross_entropy_loss)
+
+__all__ = [
+    "flash_attention", "mha_reference", "supports_flash",
+    "FocalLoss", "focal_loss",
+    "AttnMaskType", "FusedScaleMaskSoftmax", "scaled_masked_softmax",
+    "scaled_upper_triang_masked_softmax",
+    "MLP", "FusedDense", "FusedDenseGeluDense", "fused_dense",
+    "fused_dense_gelu_dense", "mlp_forward",
+    "SoftmaxCrossEntropyLoss", "softmax_cross_entropy_loss",
+]

@@ -1,0 +1,408 @@
+"""Flash attention — Pallas TPU kernel family.
+
+TPU replacement for the reference's two fused-attention stacks:
+``reference:apex/contrib/csrc/fmha/`` (FlashAttention-style fixed-seqlen
+kernels, fp16, seqlen<=512) and
+``reference:apex/contrib/csrc/multihead_attn/`` (fused QKV/softmax/AV with
+mask + optional residual+LN epilogues, seqlen<=2048 via the Megatron softmax).
+One blockwise-online-softmax kernel subsumes both with no seqlen cap: scores
+never materialize in HBM, so memory is O(sq·d) instead of O(sq·sk).
+
+Forward: grid ``(b*h, sq/block_q, sk/block_k)`` with the kv dimension
+innermost; running ``(m, l, acc)`` live in VMEM scratch across kv steps
+(TPU grid execution is sequential per core, the canonical Pallas flash
+pattern). Backward recomputes probabilities from the saved per-row logsumexp
+(same recompute-not-store trade as the CUDA dgrad kernels) in two kernels:
+one gridded over q blocks (dq), one over kv blocks (dk, dv).
+
+``bias`` is an additive score bias (the general form of the reference's
+padding masks — additive -10000 fills, ``scaled_masked_softmax.h``) and is
+non-differentiable, as in the reference. Dropout inside the kernel (the
+``philox.cuh`` path of fast_multihead_attn) is not implemented yet; apply
+dropout to the output, or pass pre-masked bias for deterministic ablation.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention", "mha_reference", "supports_flash"]
+
+NEG_INF = -1e30
+
+
+def supports_flash(sq: int, sk: int, d: int, block_q: int, block_k: int) -> bool:
+    """Eligibility for the Pallas path (cf. the reference's per-kernel seqlen
+    gates, ``fused_softmax.py:159-179`` / ``setup.py:544-560`` — here the gate
+    is only tile alignment, not a seqlen cap)."""
+    return (sq % block_q == 0 and sk % block_k == 0 and d % 8 == 0
+            and block_q % 8 == 0 and block_k % 128 == 0)
+
+
+def mha_reference(q, k, v, bias=None, causal=False,
+                  softmax_scale: Optional[float] = None):
+    """Plain-XLA attention; the parity reference for the kernel (the role of
+    the Python attention in ``reference:apex/contrib/test/fmha/test_fmha.py``)."""
+    if softmax_scale is None:
+        softmax_scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * softmax_scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(col > row + (sk - sq), NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k,
+                n_kv, offset):
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal: skip blocks entirely above the diagonal (with the sk-sq
+    # offset so cross-shaped causal matches mha_reference)
+    run = (j * block_k <= i * block_q + block_q - 1 + offset) if causal else True
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0].astype(jnp.float32)
+        if causal:
+            row = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(col > row + offset, NEG_INF, s)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[:] = m_new
+        pv = jax.lax.dot_general(p.astype(v_ref.dtype), v_ref[0],
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * corr + pv
+
+    @pl.when(j == n_kv - 1)
+    def _():
+        l = l_ref[:]
+        # fully-masked rows (l==0) produce 0 output, not NaN
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:] + jnp.log(safe_l)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale, causal, block_q, block_k, n_kv, offset):
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = (j * block_k <= i * block_q + block_q - 1 + offset) if causal else True
+
+    @pl.when(run)
+    def _():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0].astype(jnp.float32)
+        if causal:
+            row = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(col > row + offset, NEG_INF, s)
+        p = jnp.exp(s - lse_ref[0])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(j == n_kv - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_k, n_q, offset):
+    j, i = pl.program_id(1), pl.program_id(2)  # kv outer, q inner
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = (j * block_k <= i * block_q + block_q - 1 + offset) if causal else True
+
+    @pl.when(run)
+    def _():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0].astype(jnp.float32)
+        if causal:
+            row = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(col > row + offset, NEG_INF, s)
+        p = jnp.exp(s - lse_ref[0])
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(i == n_q - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call plumbing
+# ---------------------------------------------------------------------------
+
+def _interp() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_pallas(q3, k3, v3, bias3, *, scale, causal, block_q, block_k):
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    n_q, n_kv = sq // block_q, sk // block_k
+    has_bias = bias3 is not None
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                           memory_space=pltpu.VMEM)
+    in_specs = [q_spec, kv_spec, kv_spec]
+    args = [q3, k3, v3]
+    if has_bias:
+        in_specs.append(pl.BlockSpec((1, block_q, block_k),
+                                     lambda b, i, j: (b, i, j),
+                                     memory_space=pltpu.VMEM))
+        args.append(bias3)
+
+    def kernel(*refs):
+        if has_bias:
+            q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, acc, m, l = refs
+        else:
+            q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l = refs
+            bias_ref = None
+        _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, acc, m, l,
+                    scale=scale, causal=causal, block_q=block_q,
+                    block_k=block_k, n_kv=n_kv, offset=sk - sq)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_kv),
+        in_specs=in_specs,
+        out_specs=(q_spec,
+                   pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0),
+                                memory_space=pltpu.VMEM)),
+        out_shape=(jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+                   jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32)],
+        interpret=_interp(),
+    )(*args)
+    return out, lse
+
+
+def _bwd_pallas(q3, k3, v3, bias3, do3, lse, delta, *, scale, causal,
+                block_q, block_k):
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    n_q, n_kv = sq // block_q, sk // block_k
+    has_bias = bias3 is not None
+
+    # --- dq: grid (bh, n_q, n_kv), kv innermost ---
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                           memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0),
+                            memory_space=pltpu.VMEM)
+    in_specs = [q_spec, kv_spec, kv_spec]
+    args = [q3, k3, v3]
+    if has_bias:
+        in_specs.append(pl.BlockSpec((1, block_q, block_k),
+                                     lambda b, i, j: (b, i, j),
+                                     memory_space=pltpu.VMEM))
+        args.append(bias3)
+    in_specs += [q_spec, row_spec, row_spec]
+    args += [do3, lse, delta]
+
+    def dq_kernel(*refs):
+        if has_bias:
+            (q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
+             dq_ref, dq_acc) = refs
+        else:
+            (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+             dq_ref, dq_acc) = refs
+            bias_ref = None
+        _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                       delta_ref, dq_ref, dq_acc, scale=scale, causal=causal,
+                       block_q=block_q, block_k=block_k, n_kv=n_kv, offset=sk - sq)
+
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, n_q, n_kv),
+        in_specs=in_specs,
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interp(),
+    )(*args)
+
+    # --- dk/dv: grid (bh, n_kv, n_q), q innermost ---
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0),
+                           memory_space=pltpu.VMEM)
+    kv_spec2 = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
+                            memory_space=pltpu.VMEM)
+    row_spec2 = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0),
+                             memory_space=pltpu.VMEM)
+    in_specs2 = [q_spec2, kv_spec2, kv_spec2]
+    args2 = [q3, k3, v3]
+    if has_bias:
+        in_specs2.append(pl.BlockSpec((1, block_q, block_k),
+                                      lambda b, j, i: (b, i, j),
+                                      memory_space=pltpu.VMEM))
+        args2.append(bias3)
+    in_specs2 += [q_spec2, row_spec2, row_spec2]
+    args2 += [do3, lse, delta]
+
+    def dkv_kernel(*refs):
+        if has_bias:
+            (q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
+             dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        else:
+            (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+             dk_ref, dv_ref, dk_acc, dv_acc) = refs
+            bias_ref = None
+        _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                        delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                        scale=scale, causal=causal, block_q=block_q,
+                        block_k=block_k, n_q=n_q, offset=sk - sq)
+
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, n_kv, n_q),
+        in_specs=in_specs2,
+        out_specs=(kv_spec2, kv_spec2),
+        out_shape=(jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), v3.dtype)),
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=_interp(),
+    )(*args2)
+    return dq, dk, dv
+
+
+@functools.lru_cache(maxsize=None)
+def _make_flash(scale: float, causal: bool, block_q: int, block_k: int,
+                has_bias: bool):
+    @jax.custom_vjp
+    def flash(q3, k3, v3, bias3):
+        out, _ = _fwd_pallas(q3, k3, v3, bias3 if has_bias else None,
+                             scale=scale, causal=causal,
+                             block_q=block_q, block_k=block_k)
+        return out
+
+    def fwd(q3, k3, v3, bias3):
+        out, lse = _fwd_pallas(q3, k3, v3, bias3 if has_bias else None,
+                               scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+        return out, (q3, k3, v3, bias3, out, lse)
+
+    def bwd(res, do3):
+        q3, k3, v3, bias3, out, lse = res
+        delta = jnp.sum(do3.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1, keepdims=True)
+        dq, dk, dv = _bwd_pallas(q3, k3, v3, bias3 if has_bias else None,
+                                 do3, lse, delta, scale=scale, causal=causal,
+                                 block_q=block_q, block_k=block_k)
+        dbias = jnp.zeros_like(bias3) if has_bias else None
+        return dq, dk, dv, dbias
+
+    flash.defvjp(fwd, bwd)
+    return flash
+
+
+def flash_attention(q, k, v, bias=None, causal: bool = False,
+                    softmax_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    use_pallas: Optional[bool] = None):
+    """Fused attention over ``(b, h, s, d)`` tensors.
+
+    ``bias``: additive fp32 score bias broadcastable to ``(b, h, sq, sk)``
+    (use ``-10000``-filled masks for padding, as the reference softmax does).
+    Falls back to the XLA reference when shapes aren't tile-aligned.
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if softmax_scale is None:
+        softmax_scale = 1.0 / math.sqrt(d)
+    if use_pallas is None:
+        use_pallas = supports_flash(sq, sk, d, block_q, block_k)
+    if not use_pallas:
+        return mha_reference(q, k, v, bias, causal, softmax_scale)
+
+    q3 = q.reshape(b * h, sq, d)
+    k3 = k.reshape(b * h, sk, d)
+    v3 = v.reshape(b * h, sk, d)
+    has_bias = bias is not None
+    if has_bias:
+        bias3 = jnp.broadcast_to(bias.astype(jnp.float32),
+                                 (b, h, sq, sk)).reshape(b * h, sq, sk)
+    else:
+        bias3 = jnp.zeros((), jnp.float32)  # placeholder pytree leaf
+    fn = _make_flash(float(softmax_scale), bool(causal), block_q, block_k,
+                     has_bias)
+    out = fn(q3, k3, v3, bias3)
+    return out.reshape(b, h, sq, d)

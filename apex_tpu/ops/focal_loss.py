@@ -1,0 +1,67 @@
+"""Fused sigmoid focal loss (detection).
+
+Reference: ``reference:apex/contrib/focal_loss/focal_loss.py`` over
+``reference:apex/contrib/csrc/focal_loss/focal_loss_cuda_kernel.cu:30-110``.
+Target encoding per anchor: ``-2`` = ignore (zero loss/grad), ``-1`` = all
+classes are negatives, ``y >= 0`` = class ``y`` positive, rest negative.
+Element math (kernel :74-101): with ``sigma = sigmoid(logit)`` and
+``softplus(-x) = log(1+exp(-x))`` —
+
+  negative: ``(1-alpha) * sigma**gamma     * (nn*x + softplus(-x))``
+  positive: ``alpha     * (1-sigma)**gamma * (pn*x + softplus(-x))``
+
+where without smoothing ``nn=1, pn=0`` (i.e. ``-log(1-sigma)`` and
+``-log(sigma)``), and label smoothing ``s`` sets ``nn=1-s/K``, ``pn=s-s/K``.
+The sum is normalized by ``num_positives_sum``; classes at index
+``>= num_real_classes`` (padding for vectorization) are skipped. All math is
+fp32; AD provides the backward (the reference caches ``partial_grad`` only to
+avoid re-reading logits — XLA rematerializes the same expression for free).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["focal_loss", "FocalLoss"]
+
+
+def focal_loss(cls_output: jnp.ndarray, cls_targets: jnp.ndarray,
+               num_positives_sum: jnp.ndarray, num_real_classes: int,
+               alpha: float, gamma: float,
+               label_smoothing: float = 0.0) -> jnp.ndarray:
+    """Scalar total loss. ``cls_output``: ``(..., K)`` logits;
+    ``cls_targets``: ``(...,)`` int labels in {-2, -1, 0..K-1}."""
+    x = cls_output.astype(jnp.float32)
+    k = x.shape[-1]
+    y = cls_targets[..., None]
+
+    if label_smoothing > 0.0:
+        s = label_smoothing
+        nn, np_ = 1.0 - s / k, s / k
+        pn, pp = s - s / k, 1.0 - s + s / k
+    else:
+        nn, np_, pn, pp = 1.0, 0.0, 0.0, 1.0
+    del np_, pp  # forward only needs nn/pn; off_b terms belong to the grad
+
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
+    is_pos = (y >= 0) & (col == y)
+    valid = (y != -2) & (col < num_real_classes)
+
+    sigma = jax.nn.sigmoid(x)
+    off_a = jax.nn.softplus(-x)
+    loss_neg = (1.0 - alpha) * jnp.power(sigma, gamma) * (nn * x + off_a)
+    loss_pos = alpha * jnp.power(1.0 - sigma, gamma) * (pn * x + off_a)
+    elem = jnp.where(is_pos, loss_pos, loss_neg)
+    elem = jnp.where(valid, elem, 0.0)
+    return jnp.sum(elem) / num_positives_sum.astype(jnp.float32).reshape(())
+
+
+class FocalLoss:
+    """Autograd-Function-style alias for ported call sites."""
+
+    @staticmethod
+    def apply(cls_output, cls_targets_at_level, num_positives_sum,
+              num_real_classes, alpha, gamma, label_smoothing=0.0):
+        return focal_loss(cls_output, cls_targets_at_level, num_positives_sum,
+                          num_real_classes, alpha, gamma, label_smoothing)

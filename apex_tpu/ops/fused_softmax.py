@@ -1,0 +1,135 @@
+"""Fused scale + mask + softmax.
+
+Reference: ``reference:apex/transformer/functional/fused_softmax.py`` —
+``ScaledUpperTriangMaskedSoftmax`` (:21-50, causal, 3D ``(b*np, sq, sk)``),
+``ScaledMaskedSoftmax`` (:71-92, arbitrary bool mask, 4D ``(b, np, sq, sk)``),
+and the ``FusedScaleMaskSoftmax`` dispatcher (:101-207) with its kernel
+eligibility rules (:159-179) and torch fallback (:185-201).
+
+On TPU the scale+mask+softmax chain is a single XLA fusion already (one VMEM
+pass), so there is no separate Pallas kernel here — the *fused attention*
+kernel (:mod:`apex_tpu.ops.flash_attention`) is where softmax fusion buys
+memory traffic, subsuming the reference's seqlen<=2048 limit. The dispatcher
+keeps the reference's eligibility/fallback split so callers can port
+unchanged; both paths compute identical values.
+
+Mask convention matches Megatron: ``mask == True`` marks positions to *drop*,
+filled with -10000.0 before the softmax (the reference kernels use the same
+additive fill, ``reference:csrc/megatron/scaled_masked_softmax.h``).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "AttnMaskType", "scaled_upper_triang_masked_softmax",
+    "scaled_masked_softmax", "FusedScaleMaskSoftmax",
+]
+
+_MASK_FILL = -10000.0
+
+
+class AttnMaskType(enum.Enum):
+    """``reference:apex/transformer/enums.py`` (padding/causal)."""
+    padding = 1
+    causal = 2
+
+
+def scaled_upper_triang_masked_softmax(x: jnp.ndarray,
+                                       scale: float = 1.0) -> jnp.ndarray:
+    """Causal softmax over ``(..., sq, sk)`` — the
+    ``scaled_upper_triang_masked_softmax_cuda`` op. Computed in fp32, returned
+    in the input dtype."""
+    sq, sk = x.shape[-2], x.shape[-1]
+    xf = x.astype(jnp.float32) * scale
+    row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+    causal = col > row + (sk - sq)
+    xf = jnp.where(causal, _MASK_FILL, xf)
+    return jax.nn.softmax(xf, axis=-1).astype(x.dtype)
+
+
+def scaled_masked_softmax(x: jnp.ndarray, mask: Optional[jnp.ndarray],
+                          scale: float = 1.0) -> jnp.ndarray:
+    """Arbitrary-bool-mask softmax (``scaled_masked_softmax_cuda``); ``mask``
+    broadcasts over ``(b, np, sq, sk)`` and True means masked."""
+    xf = x.astype(jnp.float32) * scale
+    if mask is not None:
+        xf = jnp.where(mask, _MASK_FILL, xf)
+    return jax.nn.softmax(xf, axis=-1).astype(x.dtype)
+
+
+class FusedScaleMaskSoftmax:
+    """Dispatcher mirroring ``FusedScaleMaskSoftmax`` (:101-207).
+
+    The eligibility predicate is kept for API parity and introspection
+    (tests assert on it), though on TPU both branches lower to the same fused
+    XLA computation — ``is_kernel_available`` answers "would the reference
+    have used its CUDA kernel here".
+    """
+
+    def __init__(self, input_in_fp16: bool = False, input_in_bf16: bool = False,
+                 attn_mask_type: AttnMaskType = AttnMaskType.padding,
+                 scaled_masked_softmax_fusion: bool = True,
+                 mask_func: Optional[Callable] = None,
+                 softmax_in_fp32: bool = True,
+                 scale: Optional[float] = None):
+        if input_in_fp16 and input_in_bf16:
+            raise RuntimeError(
+                "both fp16 and bf16 flags cannot be active at the same time.")
+        self.input_in_fp16 = input_in_fp16
+        self.input_in_bf16 = input_in_bf16
+        self.input_in_float16 = input_in_fp16 or input_in_bf16
+        self.attn_mask_type = attn_mask_type
+        self.scaled_masked_softmax_fusion = scaled_masked_softmax_fusion
+        self.mask_func = mask_func
+        self.softmax_in_fp32 = softmax_in_fp32
+        self.scale = scale
+        if not (scale is None or softmax_in_fp32):
+            raise RuntimeError("softmax should be in fp32 when scaled")
+
+    def __call__(self, x: jnp.ndarray, mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+        assert x.ndim == 4, "input must be (b, np, sq, sk)"
+        scale = self.scale if self.scale is not None else 1.0
+        if self.attn_mask_type == AttnMaskType.causal:
+            b, np_, sq, sk = x.shape
+            assert sq == sk, "causal mask is only for self attention"
+            out = scaled_upper_triang_masked_softmax(
+                x.reshape(-1, sq, sk), scale)
+            return out.reshape(b, np_, sq, sk)
+        if self.mask_func is not None and not self.scaled_masked_softmax_fusion:
+            # torch-fallback parity path (:185-201): user mask_func + softmax
+            xf = x.astype(jnp.float32) if (self.input_in_float16 and
+                                           self.softmax_in_fp32) else x
+            xf = xf * scale
+            xf = self.mask_func(xf, mask) if mask is not None else xf
+            probs = jax.nn.softmax(xf, axis=-1)
+            return probs.astype(x.dtype)
+        return scaled_masked_softmax(x, mask, scale)
+
+    def is_kernel_available(self, mask, b: int, np_: int, sq: int, sk: int) -> bool:
+        """Reference eligibility (:159-179); informational on TPU."""
+        attn_batches = b * np_
+        if not (self.scaled_masked_softmax_fusion and self.input_in_float16
+                and mask is not None and 16 < sk <= 2048
+                and sq % 4 == 0 and attn_batches % 4 == 0):
+            return False
+        batch_per_block = self.get_batch_per_block(sq, sk, b, np_)
+        if self.attn_mask_type == AttnMaskType.causal:
+            return attn_batches % batch_per_block == 0
+        return sq % batch_per_block == 0
+
+    @staticmethod
+    def get_batch_per_block(sq: int, sk: int, b: int, np_: int) -> int:
+        # CUDA heuristic (scaled_masked_softmax.h): 128-thread blocks over
+        # next-pow2(sk) columns; kept so eligibility matches the reference.
+        pow2 = 1 << max(sk - 1, 1).bit_length()
+        warp_size = min(32, pow2)
+        batches_per_warp = 2 if pow2 <= 128 else 1
+        warps_per_block = 128 // warp_size
+        return warps_per_block * batches_per_warp

@@ -37,10 +37,10 @@ __all__ = ["OptimizerBase", "tree_unzip", "tree_zeros_like_f32",
            "bias_correction"]
 
 
-def tree_unzip(out: Any, treedef) -> Tuple[Any, ...]:
-    """Split a tree whose leaves are k-tuples into k trees of ``treedef``."""
+def tree_unzip(out: Any, treedef, k: int) -> Tuple[Any, ...]:
+    """Split a tree whose leaves are k-tuples into k trees of ``treedef``.
+    ``k`` is explicit so empty trees (no leaves) still unzip correctly."""
     leaves = treedef.flatten_up_to(out)
-    k = len(leaves[0])
     return tuple(treedef.unflatten([l[i] for l in leaves]) for i in range(k))
 
 

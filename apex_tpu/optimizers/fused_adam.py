@@ -85,7 +85,7 @@ class FusedAdam(OptimizerBase):
         out = jax.tree_util.tree_map(
             _update, grads, params, state.exp_avg, state.exp_avg_sq)
         new_params, new_m, new_v = tree_unzip(
-            out, jax.tree_util.tree_structure(params))
+            out, jax.tree_util.tree_structure(params), 3)
         return new_params, AdamState(step=t, exp_avg=new_m, exp_avg_sq=new_v)
 
 
@@ -128,5 +128,5 @@ class FusedAdagrad(OptimizerBase):
 
         out = jax.tree_util.tree_map(_update, grads, params, state.sum_sq)
         new_params, new_h = tree_unzip(
-            out, jax.tree_util.tree_structure(params))
+            out, jax.tree_util.tree_structure(params), 2)
         return new_params, AdagradState(step=state.step + 1, sum_sq=new_h)

@@ -105,7 +105,7 @@ class FusedLAMB(OptimizerBase):
         out = jax.tree_util.tree_map(
             _update, grads, params, state.exp_avg, state.exp_avg_sq)
         new_params, new_m, new_v = tree_unzip(
-            out, jax.tree_util.tree_structure(params))
+            out, jax.tree_util.tree_structure(params), 3)
         return new_params, LAMBState(step=t, exp_avg=new_m, exp_avg_sq=new_v)
 
 
@@ -124,6 +124,10 @@ class FusedMixedPrecisionLamb(OptimizerBase):
 
     def __init__(self, **lamb_kwargs):
         self._lamb = FusedLAMB(**lamb_kwargs)
+        # mirror the inner hyperparams so wrappers (LARC) and schedulers see
+        # the same surface as every other optimizer here
+        self.lr = self._lamb.lr
+        self.weight_decay = self._lamb.weight_decay
 
     def init(self, params: Any) -> MixedPrecisionLambState:
         master = jax.tree_util.tree_map(
@@ -134,11 +138,16 @@ class FusedMixedPrecisionLamb(OptimizerBase):
             exp_avg=inner.exp_avg, exp_avg_sq=inner.exp_avg_sq)
 
     def _step(self, grads: Any, state: MixedPrecisionLambState, params: Any,
-              lr: Optional[Any] = None, grad_scale: Any = 1.0
-              ) -> Tuple[Any, MixedPrecisionLambState]:
+              lr: Optional[Any] = None, weight_decay: Optional[Any] = None,
+              grad_scale: Any = 1.0) -> Tuple[Any, MixedPrecisionLambState]:
+        if lr is None:
+            lr = self.lr
+        if weight_decay is None:
+            weight_decay = self.weight_decay
         inner_state = LAMBState(state.step, state.exp_avg, state.exp_avg_sq)
         new_master, new_inner = self._lamb._step(
-            grads, inner_state, state.master_params, lr=lr, grad_scale=grad_scale)
+            grads, inner_state, state.master_params, lr=lr,
+            weight_decay=weight_decay, grad_scale=grad_scale)
         new_params = jax.tree_util.tree_map(
             lambda mp, p: mp.astype(jnp.asarray(p).dtype), new_master, params)
         return new_params, MixedPrecisionLambState(

@@ -3,7 +3,8 @@
 Reference: ``reference:apex/optimizers/fused_novograd.py:4-213`` +
 ``reference:csrc/multi_tensor_novograd.cu:96-127``. The second moment is one
 scalar *norm* per tensor (not squared; ``fused_novograd.py:157-176``), blended
-``v = beta2*v + (1-beta2)*||g||`` with ``norm_type`` 2 (L2) or 0 (L-inf); if
+in RMS form for ``norm_type=2`` (``sqrt(b2*v^2 + (1-b2)*||g||^2)``) and
+linearly for ``norm_type=0`` (L-inf); if
 ``init_zero`` is false the first step seeds ``v = ||g||`` so the first blend is
 a no-op. MOMENT_MODE_0 (``reg_inside_moment``) normalizes+decays the grad
 before the momentum blend; MOMENT_MODE_1 (default) is decoupled.
@@ -80,11 +81,18 @@ class FusedNovoGrad(OptimizerBase):
             p32 = jnp.asarray(p).astype(jnp.float32)
             g32 = jnp.asarray(g).astype(jnp.float32)
             gn = self._grad_norm(g32)
+            # L2 blends in RMS form, L-inf linearly
+            # (reference:csrc/multi_tensor_l2norm_kernel.cu multi_tensor_norm_out:
+            #  "L-2: gn = sqrt(a*gn^2 + b*n^2); L-inf: gn = a*gn + b*n")
+            if self.norm_type == 2:
+                blended = jnp.sqrt(b2 * v * v + (1.0 - b2) * gn * gn)
+            else:
+                blended = b2 * v + (1.0 - b2) * gn
             if self.init_zero:
-                new_v = b2 * v + (1.0 - b2) * gn
+                new_v = blended
             else:
                 # first step seeds v = ||g|| so the blend is identity
-                new_v = jnp.where(first, gn, b2 * v + (1.0 - b2) * gn)
+                new_v = jnp.where(first, gn, blended)
             denom = new_v / bc2 + eps
             if self.reg_inside_moment:  # MOMENT_MODE_0
                 gg = g32 / denom + wd * p32
@@ -99,5 +107,5 @@ class FusedNovoGrad(OptimizerBase):
         out = jax.tree_util.tree_map(
             _update, grads, params, state.exp_avg, state.exp_avg_sq)
         new_params, new_m, new_v = tree_unzip(
-            out, jax.tree_util.tree_structure(params))
+            out, jax.tree_util.tree_structure(params), 3)
         return new_params, NovoGradState(step=t, exp_avg=new_m, exp_avg_sq=new_v)

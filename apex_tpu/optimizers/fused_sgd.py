@@ -78,5 +78,5 @@ class FusedSGD(OptimizerBase):
 
         out = jax.tree_util.tree_map(_update, grads, params, state.momentum_buf)
         new_params, new_buf = tree_unzip(
-            out, jax.tree_util.tree_structure(params))
+            out, jax.tree_util.tree_structure(params), 2)
         return new_params, SGDState(step=state.step + 1, momentum_buf=new_buf)

@@ -1,0 +1,156 @@
+"""LayerNorm/RMSNorm parity tests.
+
+Model: ``reference:tests/L0/run_fused_layer_norm/test_fused_layer_norm.py`` —
+forward/backward vs ``torch.nn.LayerNorm`` (and manual RMS), per-dtype
+tolerances, both the XLA path and the Pallas kernel (run in interpreter mode
+on CPU).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu import normalization as norm
+
+
+def _data(shape=(4, 6, 512), seed=0, dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    return rng.randn(*shape).astype(dtype)
+
+
+@pytest.mark.parametrize("use_pallas", [False, True])
+@pytest.mark.parametrize("hidden", [512, 384])
+def test_layer_norm_affine_fwd_bwd_vs_torch(use_pallas, hidden):
+    x_np = _data((8, hidden))
+    w_np = _data((hidden,), 1) * 0.1 + 1.0
+    b_np = _data((hidden,), 2) * 0.1
+    dy_np = _data((8, hidden), 3)
+
+    def f(x, w, b):
+        out = norm.fused_layer_norm_affine(x, w, b, hidden,
+                                           use_pallas=use_pallas)
+        return jnp.sum(out * jnp.asarray(dy_np))
+
+    out = norm.fused_layer_norm_affine(
+        jnp.asarray(x_np), jnp.asarray(w_np), jnp.asarray(b_np), hidden,
+        use_pallas=use_pallas)
+    dx, dw, db = jax.grad(f, argnums=(0, 1, 2))(
+        jnp.asarray(x_np), jnp.asarray(w_np), jnp.asarray(b_np))
+
+    tx = torch.tensor(x_np, requires_grad=True)
+    tw = torch.tensor(w_np, requires_grad=True)
+    tb = torch.tensor(b_np, requires_grad=True)
+    tout = torch.nn.functional.layer_norm(tx, (hidden,), tw, tb, eps=1e-5)
+    tout.backward(torch.tensor(dy_np))
+
+    np.testing.assert_allclose(np.asarray(out), tout.detach().numpy(),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(dx), tx.grad.numpy(), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(dw), tw.grad.numpy(), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(db), tb.grad.numpy(), rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("use_pallas", [False, True])
+def test_rms_norm_affine_fwd_bwd(use_pallas):
+    hidden = 256
+    x_np = _data((16, hidden), 4)
+    w_np = _data((hidden,), 5) * 0.1 + 1.0
+    dy_np = _data((16, hidden), 6)
+
+    out = norm.fused_rms_norm_affine(
+        jnp.asarray(x_np), jnp.asarray(w_np), hidden, use_pallas=use_pallas)
+
+    # manual torch RMS reference (fused_layer_norm.py:381-388 fallback math)
+    tx = torch.tensor(x_np, requires_grad=True)
+    tw = torch.tensor(w_np, requires_grad=True)
+    trms = torch.rsqrt(tx.pow(2).mean(-1, keepdim=True) + 1e-5)
+    tout = tx * trms * tw
+    tout.backward(torch.tensor(dy_np))
+    np.testing.assert_allclose(np.asarray(out), tout.detach().numpy(),
+                               rtol=2e-5, atol=2e-5)
+
+    def f(x, w):
+        o = norm.fused_rms_norm_affine(x, w, hidden, use_pallas=use_pallas)
+        return jnp.sum(o * jnp.asarray(dy_np))
+
+    dx, dw = jax.grad(f, argnums=(0, 1))(jnp.asarray(x_np), jnp.asarray(w_np))
+    np.testing.assert_allclose(np.asarray(dx), tx.grad.numpy(), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(dw), tw.grad.numpy(), rtol=2e-4, atol=2e-4)
+
+
+def test_no_affine_paths():
+    x = jnp.asarray(_data((4, 128), 7))
+    out = norm.fused_layer_norm(x, 128)
+    tout = torch.nn.functional.layer_norm(torch.tensor(np.asarray(x)), (128,))
+    np.testing.assert_allclose(np.asarray(out), tout.numpy(), rtol=2e-5, atol=2e-5)
+    out = norm.fused_rms_norm(x, 128)
+    assert out.shape == (4, 128)
+
+
+def test_multidim_normalized_shape():
+    x = jnp.asarray(_data((3, 4, 8, 16), 8))
+    m = norm.FusedLayerNorm((8, 16))
+    params = m.init()
+    out = m(params, x)
+    tout = torch.nn.functional.layer_norm(
+        torch.tensor(np.asarray(x)), (8, 16),
+        torch.ones(8, 16), torch.zeros(8, 16))
+    np.testing.assert_allclose(np.asarray(out), tout.numpy(), rtol=2e-5, atol=2e-5)
+
+
+def test_mixed_dtype_output_rule():
+    """Standard: out dtype = input dtype; Mixed: out dtype = weight dtype
+    (csrc/layer_norm_cuda.cpp:183-189 vs :205)."""
+    hidden = 128
+    x = jnp.asarray(_data((4, hidden), 9), jnp.bfloat16)
+    w = jnp.ones(hidden, jnp.float32)
+    b = jnp.zeros(hidden, jnp.float32)
+
+    out_std = norm.fused_layer_norm_affine(x, w.astype(jnp.bfloat16),
+                                           b.astype(jnp.bfloat16), hidden)
+    assert out_std.dtype == jnp.bfloat16
+
+    out_mixed = norm.mixed_dtype_fused_layer_norm_affine(x, w, b, hidden)
+    assert out_mixed.dtype == jnp.float32
+
+    m = norm.MixedFusedRMSNorm(hidden)
+    out = m(m.init(), x)
+    assert out.dtype == jnp.float32
+
+    m2 = norm.FusedRMSNorm(hidden, param_dtype=jnp.bfloat16)
+    assert m2(m2.init(), x).dtype == jnp.bfloat16
+
+
+def test_bf16_stats_in_fp32():
+    """bf16 input must not lose the mean to rounding: stats are fp32
+    (csrc/layer_norm_cuda.cpp:161)."""
+    hidden = 256
+    x32 = _data((8, hidden), 10) * 3.0 + 100.0  # large offset stresses stats
+    x16 = jnp.asarray(x32, jnp.bfloat16)
+    out = norm.fused_layer_norm(x16, hidden)
+    ref = torch.nn.functional.layer_norm(
+        torch.tensor(np.asarray(x16, np.float32)), (hidden,))
+    np.testing.assert_allclose(np.asarray(out, np.float32), ref.numpy(),
+                               rtol=0.05, atol=0.05)
+
+
+def test_shape_mismatch_raises():
+    x = jnp.zeros((4, 100))
+    with pytest.raises(ValueError):
+        norm.fused_layer_norm(x, 128)
+
+
+def test_jit_and_grad_through_module():
+    m = norm.FusedRMSNorm(128)
+    params = m.init()
+    x = jnp.asarray(_data((4, 128), 11))
+
+    @jax.jit
+    def loss(p, x):
+        return jnp.mean(m(p, x) ** 2)
+
+    g = jax.grad(loss)(params, x)
+    assert g["weight"].shape == (128,)
+    assert np.isfinite(np.asarray(g["weight"])).all()

@@ -1,0 +1,198 @@
+"""Op parity tests (softmax, xentropy, focal loss, MLP/dense).
+
+Models: ``reference:tests/L0/run_transformer/test_fused_softmax.py``,
+``apex/contrib/test/test_label_smoothing.py``,
+``apex/contrib/test/focal_loss/test_focal_loss.py``,
+``tests/L0/run_mlp/test_mlp.py``, ``apex/contrib/test/fused_dense/``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu import ops
+
+
+# ---------------------------------------------------------------------------
+# fused softmax
+# ---------------------------------------------------------------------------
+
+def test_scaled_masked_softmax_vs_torch():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 8, 24).astype(np.float32)
+    mask = rng.rand(2, 1, 8, 24) > 0.7
+    out = ops.scaled_masked_softmax(jnp.asarray(x), jnp.asarray(mask), 0.5)
+    tx = torch.tensor(x) * 0.5
+    tx = tx.masked_fill(torch.tensor(mask), -10000.0)
+    ref = torch.softmax(tx, dim=-1)
+    np.testing.assert_allclose(np.asarray(out), ref.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_causal_softmax_matches_masked():
+    rng = np.random.RandomState(1)
+    x = rng.randn(3, 16, 16).astype(np.float32)
+    out = ops.scaled_upper_triang_masked_softmax(jnp.asarray(x), 1.0)
+    tril = np.tril(np.ones((16, 16), bool))
+    ref = torch.softmax(
+        torch.tensor(x).masked_fill(~torch.tensor(tril), -10000.0), dim=-1)
+    np.testing.assert_allclose(np.asarray(out), ref.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_fused_scale_mask_softmax_dispatcher():
+    sm = ops.FusedScaleMaskSoftmax(
+        input_in_bf16=True, attn_mask_type=ops.AttnMaskType.causal,
+        scaled_masked_softmax_fusion=True, mask_func=None,
+        softmax_in_fp32=True, scale=0.25)
+    x = jnp.asarray(np.random.RandomState(2).randn(2, 4, 16, 16), jnp.bfloat16)
+    out = sm(x, None)
+    assert out.shape == x.shape and out.dtype == jnp.bfloat16
+    # rows sum to 1
+    np.testing.assert_allclose(np.asarray(out.sum(-1), np.float32),
+                               np.ones((2, 4, 16)), rtol=0.02)
+    # reference kernel-eligibility logic is preserved
+    assert sm.is_kernel_available(jnp.ones((2, 1, 16, 16), bool), 2, 4, 16, 64)
+    assert not sm.is_kernel_available(None, 2, 4, 16, 64)
+    assert not sm.is_kernel_available(jnp.ones((2, 1, 16, 16), bool), 2, 4, 16, 4096)
+
+
+# ---------------------------------------------------------------------------
+# xentropy
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_xentropy_vs_torch(smoothing):
+    rng = np.random.RandomState(3)
+    logits = rng.randn(32, 50).astype(np.float32)
+    labels = rng.randint(0, 50, size=(32,))
+    labels[:4] = 0  # padding_idx rows
+
+    out = ops.softmax_cross_entropy_loss(
+        jnp.asarray(logits), jnp.asarray(labels), smoothing=smoothing,
+        padding_idx=0)
+
+    tl = torch.tensor(logits, requires_grad=True)
+    ref = torch.nn.functional.cross_entropy(
+        tl, torch.tensor(labels), reduction="none",
+        label_smoothing=smoothing)
+    ref = ref.masked_fill(torch.tensor(labels) == 0, 0.0)
+    np.testing.assert_allclose(np.asarray(out), ref.detach().numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+    # grads
+    def loss_fn(lg):
+        return jnp.sum(ops.softmax_cross_entropy_loss(
+            lg, jnp.asarray(labels), smoothing=smoothing, padding_idx=0))
+
+    g = jax.grad(loss_fn)(jnp.asarray(logits))
+    ref.sum().backward()
+    np.testing.assert_allclose(np.asarray(g), tl.grad.numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_xentropy_memory_structure():
+    """Backward recomputes probs from logits+mlse — the saved residuals must
+    not include the softmax (the point of the fusion)."""
+    logits = jnp.asarray(np.random.RandomState(4).randn(8, 1000), jnp.float32)
+    labels = jnp.asarray(np.arange(8) + 1)
+    jaxpr = jax.make_jaxpr(
+        lambda lg: jax.vjp(lambda l: ops.softmax_cross_entropy_loss(
+            l, labels, 0.1, 0).sum(), lg)[0])(logits)
+    assert "exp" not in str(jaxpr.jaxpr.outvars)  # structural smoke
+
+
+# ---------------------------------------------------------------------------
+# focal loss
+# ---------------------------------------------------------------------------
+
+def _focal_ref_numpy(x, y, npos, num_real, alpha, gamma, s):
+    """Direct transcription of focal_loss_cuda_kernel.cu:30-110 math."""
+    n, k = x.shape
+    if s > 0:
+        nn, np_ = 1 - s / k, s / k
+        pn, pp = s - s / k, 1 - s + s / k
+    else:
+        nn, np_, pn, pp = 1.0, 0.0, 0.0, 1.0
+    total = 0.0
+    for i in range(n):
+        if y[i] == -2:
+            continue
+        for c in range(k):
+            if c >= num_real:
+                continue
+            p = x[i, c]
+            sigma = 1 / (1 + np.exp(-p))
+            off_a = np.log1p(np.exp(-abs(p))) + max(-p, 0)
+            if y[i] >= 0 and c == y[i]:
+                coeff_f = alpha * (1 - sigma) ** gamma
+                base = pn * p
+            else:
+                coeff_f = (1 - alpha) * sigma ** gamma
+                base = nn * p
+            total += coeff_f * (base + off_a)
+    return total / npos
+
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_focal_loss_vs_kernel_math(smoothing):
+    rng = np.random.RandomState(5)
+    x = rng.randn(12, 8).astype(np.float32)
+    y = rng.randint(-2, 8, size=(12,))
+    npos = max((y >= 0).sum(), 1)
+    out = ops.focal_loss(jnp.asarray(x), jnp.asarray(y),
+                         jnp.asarray(float(npos)), num_real_classes=6,
+                         alpha=0.25, gamma=2.0, label_smoothing=smoothing)
+    ref = _focal_ref_numpy(x, y, npos, 6, 0.25, 2.0, smoothing)
+    np.testing.assert_allclose(float(out), ref, rtol=1e-5)
+    g = jax.grad(lambda lg: ops.focal_loss(
+        lg, jnp.asarray(y), jnp.asarray(float(npos)), 6, 0.25, 2.0,
+        smoothing))(jnp.asarray(x))
+    assert np.isfinite(np.asarray(g)).all()
+    # ignored rows and pad classes have zero grad
+    assert np.all(np.asarray(g)[y == -2] == 0)
+    assert np.all(np.asarray(g)[:, 6:] == 0)
+
+
+# ---------------------------------------------------------------------------
+# MLP / fused dense
+# ---------------------------------------------------------------------------
+
+def test_mlp_vs_torch():
+    sizes = (16, 32, 8)
+    m = ops.MLP(sizes, bias=True, activation="relu")
+    params = m.init(jax.random.PRNGKey(0))
+    x = np.random.RandomState(6).randn(4, 16).astype(np.float32)
+    out = m(params, jnp.asarray(x))
+
+    tx = torch.tensor(x)
+    h = tx
+    for w, b in params:
+        lin = torch.nn.functional.linear(
+            h, torch.tensor(np.asarray(w)), torch.tensor(np.asarray(b)))
+        h = torch.relu(lin)
+    np.testing.assert_allclose(np.asarray(out), h.numpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_fused_dense_gelu_dense():
+    d = ops.FusedDenseGeluDense(16, 64, 8)
+    params = d.init(jax.random.PRNGKey(1))
+    x = jnp.asarray(np.random.RandomState(7).randn(4, 16), jnp.float32)
+    out = d(params, x)
+    tx = torch.tensor(np.asarray(x))
+    h = torch.nn.functional.linear(
+        tx, torch.tensor(np.asarray(params["dense1"]["weight"])),
+        torch.tensor(np.asarray(params["dense1"]["bias"])))
+    h = torch.nn.functional.gelu(h, approximate="tanh")
+    ref = torch.nn.functional.linear(
+        h, torch.tensor(np.asarray(params["dense2"]["weight"])),
+        torch.tensor(np.asarray(params["dense2"]["bias"])))
+    np.testing.assert_allclose(np.asarray(out), ref.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_mlp_bf16_fp32_accum():
+    m = ops.MLP((256, 256), activation="none", param_dtype=jnp.bfloat16)
+    params = m.init(jax.random.PRNGKey(2))
+    x = jnp.ones((2, 256), jnp.bfloat16)
+    out = m(params, x)
+    assert out.dtype == jnp.bfloat16
