@@ -1,0 +1,80 @@
+"""Data-parallel layer (``reference:apex/parallel/__init__.py``).
+
+- :class:`DistributedDataParallel` / :func:`allreduce_grads` — grad psum with
+  apex DDP's numeric options (predivide, fp32-allreduce, averaging).
+- :class:`SyncBatchNorm` / :func:`sync_batch_norm` — cross-device BN.
+- :func:`convert_syncbn_model` — BN→SyncBN conversion for this package's
+  module objects (the reference's recursive torch-module surgery,
+  ``reference:apex/parallel/__init__.py:21-56``).
+- :func:`create_syncbn_process_group` — BN groups of size N as psum
+  ``axis_index_groups`` (``reference:apex/parallel/__init__.py:58+``).
+- :class:`LARC` re-export (lives with the optimizers;
+  ``reference:apex/parallel/LARC.py``).
+"""
+
+from typing import List, Optional, Sequence
+
+from apex_tpu.optimizers.larc import LARC  # noqa: F401
+from apex_tpu.parallel.distributed import (  # noqa: F401
+    DistributedDataParallel, Reducer, allreduce_grads)
+from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
+    BatchNormState, SyncBatchNorm, sync_batch_norm)
+
+__all__ = [
+    "DistributedDataParallel", "Reducer", "allreduce_grads",
+    "SyncBatchNorm", "BatchNormState", "sync_batch_norm",
+    "convert_syncbn_model", "create_syncbn_process_group", "LARC",
+]
+
+
+def convert_syncbn_model(module, axis_name: str = "data",
+                         axis_index_groups=None):
+    """Recursively rebuild a module tree, replacing any
+    :class:`~apex_tpu.parallel.sync_batchnorm.SyncBatchNorm` configured
+    without a mesh axis (i.e. plain local BN) with a synced one
+    (``reference:apex/parallel/__init__.py:21-56``). Works on this package's
+    module objects and plain containers of them; other objects pass through.
+    """
+    if isinstance(module, SyncBatchNorm):
+        if module.axis_name is not None:
+            return module  # already synced; keep its axis/groups config
+        return SyncBatchNorm(
+            module.num_features, eps=module.eps, momentum=module.momentum,
+            affine=module.affine,
+            track_running_stats=module.track_running_stats,
+            axis_name=axis_name, axis_index_groups=axis_index_groups,
+            channel_axis=module.channel_axis, fuse_relu=module.fuse_relu,
+            param_dtype=module.param_dtype)
+    if isinstance(module, (list, tuple)):
+        return type(module)(
+            convert_syncbn_model(m, axis_name, axis_index_groups)
+            for m in module)
+    if isinstance(module, dict):
+        return {k: convert_syncbn_model(v, axis_name, axis_index_groups)
+                for k, v in module.items()}
+    # generic object: rewrite attributes that are BN/containers in place
+    if hasattr(module, "__dict__"):
+        for k, v in vars(module).items():
+            if isinstance(v, (SyncBatchNorm, list, tuple, dict)):
+                setattr(module, k,
+                        convert_syncbn_model(v, axis_name, axis_index_groups))
+    return module
+
+
+def create_syncbn_process_group(group_size: int,
+                                world_size: Optional[int] = None
+                                ) -> List[List[int]]:
+    """Partition ``world_size`` devices into BN groups of ``group_size`` —
+    returned as ``axis_index_groups`` for psum. ``group_size=0`` means one
+    global group (None semantics)."""
+    import jax
+
+    if world_size is None:
+        world_size = jax.device_count()
+    if group_size == 0:
+        return [list(range(world_size))]
+    if world_size % group_size != 0:
+        raise ValueError(
+            f"world_size {world_size} not divisible by group_size {group_size}")
+    return [list(range(i, i + group_size))
+            for i in range(0, world_size, group_size)]

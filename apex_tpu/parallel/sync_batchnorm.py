@@ -1,0 +1,163 @@
+"""SyncBatchNorm — cross-device batch normalization via ``psum``.
+
+Reference: two implementations with identical semantics —
+``reference:apex/parallel/optimized_sync_batchnorm_kernel.py:10-119`` (CUDA
+Welford local stats → allgather → ``welford_parallel`` count-weighted merge →
+normalize; backward allreduces ``(sum_dy, sum_dy_xmu)``) and the pure-Python
+fallback ``reference:apex/parallel/sync_batchnorm_kernel.py:7-119``.
+
+TPU version: local ``(sum, sum_sq, count)`` + one ``psum`` gives the same
+count-weighted global mean/var (mathematically identical to the parallel
+Welford merge of ``welford.cu:569``, including uneven per-rank batches —
+``tests/distributed/synced_batchnorm/two_gpu_test_different_batch_size.py``);
+the backward collective falls out of AD: the transpose of ``psum`` reproduces
+exactly the ``allreduce(sum_dy, sum_dy_xmu)`` of the reference backward.
+The fused ReLU + residual-add options of the optimized kernel
+(``syncbn.welford_mean_var`` + ``relu_backward_c_last``,
+``optimized_sync_batchnorm.py:9``'s ``fuse_relu``/``z``) are the ``fuse_relu``
+and ``z`` arguments; channels-last layouts are an XLA concern and need no API.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["BatchNormState", "SyncBatchNorm", "sync_batch_norm"]
+
+
+class BatchNormState(NamedTuple):
+    """Running stats (fp32), updated functionally each training call."""
+    running_mean: jnp.ndarray
+    running_var: jnp.ndarray
+    num_batches_tracked: jnp.ndarray
+
+
+def _reduce_axes(x: jnp.ndarray, channel_axis: int) -> Tuple[int, ...]:
+    return tuple(i for i in range(x.ndim) if i != channel_axis % x.ndim)
+
+
+def _prod(xs) -> int:
+    p = 1
+    for v in xs:
+        p *= int(v)
+    return p
+
+
+def sync_batch_norm(
+    x: jnp.ndarray,
+    weight: Optional[jnp.ndarray],
+    bias: Optional[jnp.ndarray],
+    state: BatchNormState,
+    *,
+    training: bool = True,
+    momentum: float = 0.1,
+    eps: float = 1e-5,
+    channel_axis: int = 1,
+    axis_name: Optional[str] = None,
+    axis_index_groups: Optional[Sequence[Sequence[int]]] = None,
+    z: Optional[jnp.ndarray] = None,
+    fuse_relu: bool = False,
+) -> Tuple[jnp.ndarray, BatchNormState]:
+    """Returns ``(out, new_state)``.
+
+    ``channel_axis=1`` matches torch NCHW; pass ``-1`` for NHWC. When
+    ``axis_name`` is None this is ordinary BN (the single-process fallback of
+    ``optimized_sync_batchnorm.py:70``). ``z`` is the pre-activation residual
+    added before the optional fused ReLU.
+    """
+    c_ax = channel_axis % x.ndim
+    red = _reduce_axes(x, c_ax)
+    xf = x.astype(jnp.float32)
+    stat_shape = [1] * x.ndim
+    stat_shape[c_ax] = x.shape[c_ax]
+
+    if training:
+        # local partial sums; one psum merges count-weighted across devices
+        local_count = jnp.asarray(
+            _prod(x.shape[i] for i in red), jnp.float32)
+        s1 = jnp.sum(xf, axis=red)
+        s2 = jnp.sum(xf * xf, axis=red)
+        if axis_name is not None:
+            from apex_tpu.parallel.distributed import grouped_psum
+            s1 = grouped_psum(s1, axis_name, axis_index_groups)
+            s2 = grouped_psum(s2, axis_name, axis_index_groups)
+            count = grouped_psum(local_count, axis_name, axis_index_groups)
+        else:
+            count = local_count
+        mean = s1 / count
+        var = s2 / count - mean * mean  # biased, used for normalization
+        unbiased = var * count / jnp.maximum(count - 1.0, 1.0)
+        new_state = BatchNormState(
+            running_mean=(1 - momentum) * state.running_mean + momentum * mean,
+            running_var=(1 - momentum) * state.running_var + momentum * unbiased,
+            num_batches_tracked=state.num_batches_tracked + 1)
+    else:
+        mean, var = state.running_mean, state.running_var
+        new_state = state
+
+    inv = jax.lax.rsqrt(var + eps)
+    out = (xf - mean.reshape(stat_shape)) * inv.reshape(stat_shape)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32).reshape(stat_shape)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32).reshape(stat_shape)
+    if z is not None:
+        out = out + z.astype(jnp.float32)
+    if fuse_relu:
+        out = jax.nn.relu(out)
+    return out.astype(x.dtype), new_state
+
+
+class SyncBatchNorm:
+    """``apex.parallel.SyncBatchNorm`` (``optimized_sync_batchnorm.py:9``) as a
+    param/state factory. ``process_group`` becomes ``axis_index_groups``."""
+
+    def __init__(self, num_features: int, eps: float = 1e-5,
+                 momentum: float = 0.1, affine: bool = True,
+                 track_running_stats: bool = True,
+                 axis_name: Optional[str] = None,
+                 axis_index_groups: Optional[Sequence[Sequence[int]]] = None,
+                 channel_axis: int = 1, fuse_relu: bool = False,
+                 param_dtype=jnp.float32):
+        self.num_features = num_features
+        self.eps = eps
+        self.momentum = momentum
+        self.affine = affine
+        self.track_running_stats = track_running_stats
+        self.axis_name = axis_name
+        self.axis_index_groups = axis_index_groups
+        self.channel_axis = channel_axis
+        self.fuse_relu = fuse_relu
+        self.param_dtype = param_dtype
+
+    def init(self) -> Tuple[dict, BatchNormState]:
+        params = {}
+        if self.affine:
+            params = {"weight": jnp.ones(self.num_features, self.param_dtype),
+                      "bias": jnp.zeros(self.num_features, self.param_dtype)}
+        state = BatchNormState(
+            running_mean=jnp.zeros(self.num_features, jnp.float32),
+            running_var=jnp.ones(self.num_features, jnp.float32),
+            num_batches_tracked=jnp.asarray(0, jnp.int32))
+        return params, state
+
+    def __call__(self, params: dict, state: BatchNormState, x: jnp.ndarray,
+                 training: bool = True, z: Optional[jnp.ndarray] = None
+                 ) -> Tuple[jnp.ndarray, BatchNormState]:
+        # track_running_stats=False: always normalize with batch stats and
+        # never touch running state (torch/reference semantics,
+        # optimized_sync_batchnorm.py:58-74)
+        use_batch_stats = training or not self.track_running_stats
+        out, new_state = sync_batch_norm(
+            x, params.get("weight"), params.get("bias"), state,
+            training=use_batch_stats,
+            momentum=self.momentum, eps=self.eps,
+            channel_axis=self.channel_axis, axis_name=self.axis_name,
+            axis_index_groups=self.axis_index_groups, z=z,
+            fuse_relu=self.fuse_relu)
+        if not self.track_running_stats:
+            new_state = state
+        return out, new_state

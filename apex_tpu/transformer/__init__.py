@@ -1,0 +1,21 @@
+"""Megatron-style model-parallel toolkit on a TPU mesh.
+
+Reference export list: ``reference:apex/transformer/__init__.py:1-23``.
+"""
+
+from apex_tpu.transformer import amp  # noqa: F401
+from apex_tpu.transformer import parallel_state  # noqa: F401
+from apex_tpu.transformer import pipeline_parallel  # noqa: F401
+from apex_tpu.transformer import tensor_parallel  # noqa: F401
+from apex_tpu.transformer.enums import (  # noqa: F401
+    AttnMaskType, AttnType, LayerType, ModelType)
+from apex_tpu.ops.fused_softmax import FusedScaleMaskSoftmax  # noqa: F401
+
+# `functional` namespace parity (reference:apex/transformer/functional)
+from apex_tpu.ops import fused_softmax as functional  # noqa: F401
+
+__all__ = [
+    "amp", "functional", "parallel_state", "pipeline_parallel",
+    "tensor_parallel", "AttnMaskType", "AttnType", "LayerType", "ModelType",
+    "FusedScaleMaskSoftmax",
+]

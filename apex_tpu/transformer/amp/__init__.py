@@ -1,0 +1,5 @@
+"""Model-parallel-aware loss scaling (``reference:apex/transformer/amp/``)."""
+
+from apex_tpu.transformer.amp.grad_scaler import GradScaler  # noqa: F401
+
+__all__ = ["GradScaler"]

@@ -1,0 +1,66 @@
+"""TP/PP-aware dynamic loss scaling.
+
+Reference: ``reference:apex/transformer/amp/grad_scaler.py:38-49`` — a
+``torch.cuda.amp.GradScaler`` subclass whose ``_maybe_opt_step``/``update``
+allreduce ``found_inf`` with MAX over the **model-parallel group**, so every
+TP/PP shard skips (or keeps) the step together even when only one shard
+overflowed.
+
+Here the same contract wraps :class:`apex_tpu.amp.DynamicLossScale`: the
+finite flag is reduced (min of "is finite" == max of "found inf") over the
+model axes before the scale update and the select-skip.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import (
+    DynamicLossScale, LossScaleState, all_finite, select_tree)
+from apex_tpu.transformer.parallel_state import PIPE_AXIS, TENSOR_AXIS
+
+__all__ = ["GradScaler"]
+
+
+class GradScaler:
+    """Functional grad scaler synchronized over model-parallel axes.
+
+    Usage inside a shard_mapped step::
+
+        scaler = GradScaler(init_scale=2**16)
+        state = scaler.init()
+        finite = scaler.all_finite_synced(grads)      # reduced over tp+pp
+        new_state = scaler.update(state, finite)
+        params, opt_state = opt.step(grads, opt_state, params,
+                                     grads_finite=finite)
+    """
+
+    def __init__(self, init_scale: float = 2.0 ** 16, growth_factor: float = 2.0,
+                 backoff_factor: float = 0.5, growth_interval: int = 2000,
+                 model_parallel_axes: Sequence[str] = (TENSOR_AXIS, PIPE_AXIS)):
+        self._inner = DynamicLossScale(
+            init_scale=init_scale, growth_factor=growth_factor,
+            backoff_factor=backoff_factor, growth_interval=growth_interval)
+        self.model_parallel_axes = tuple(model_parallel_axes)
+
+    def init(self) -> LossScaleState:
+        return self._inner.init()
+
+    def scale(self, state: LossScaleState, tree: Any) -> Any:
+        return self._inner.scale(state, tree)
+
+    def unscale(self, state: LossScaleState, grads: Any,
+                cast_to=jnp.float32) -> Any:
+        return self._inner.unscale(state, grads, cast_to)
+
+    def all_finite_synced(self, grads: Any) -> jnp.ndarray:
+        """found_inf MAX-allreduce over the model-parallel group
+        (``grad_scaler.py:38-49``), as a min-reduce of the finite flag."""
+        return all_finite(grads, axis_names=self.model_parallel_axes)
+
+    def update(self, state: LossScaleState, grads_finite: jnp.ndarray
+               ) -> LossScaleState:
+        return self._inner.update(state, grads_finite)

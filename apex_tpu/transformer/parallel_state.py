@@ -1,0 +1,262 @@
+"""Model-parallel device-mesh state.
+
+Reference: ``reference:apex/transformer/parallel_state.py`` —
+``initialize_model_parallel`` (:73-247) carves NCCL process groups for
+DP / TP / PP / "model" / embedding from a (tp_size, pp_size, vpp_size)
+spec; plus ~30 rank/world accessors (:273-549) and ``destroy_model_parallel``
+(:555-580).
+
+TPU-native redesign: the process-group zoo becomes ONE
+``jax.sharding.Mesh`` with axes ``("pipe", "data", "tensor")``, reshaped
+from the device list in the same rank order the reference uses (tp fastest,
+then dp, then pp — ``parallel_state.py:153-247``), so rank arithmetic is
+identical. "Process groups" are just axis names (collectives) or
+``axis_index_groups``; the embedding group (first+last stage tying,
+:215-247) is expressed by the embedding-grad psum in the pipeline schedule.
+
+Accessors come in two flavors:
+- static (host Python): sizes, this-process coordinates when running
+  multi-process (from ``jax.process_index``), enums of groups;
+- traced (inside ``shard_map``): ``get_*_rank()`` uses ``lax.axis_index``
+  so the same call sites work under jit, mirroring how reference call sites
+  query ranks inside the step.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = [
+    "initialize_model_parallel", "destroy_model_parallel",
+    "model_parallel_is_initialized", "get_mesh",
+    "get_tensor_model_parallel_world_size", "get_pipeline_model_parallel_world_size",
+    "get_data_parallel_world_size", "get_virtual_pipeline_model_parallel_world_size",
+    "get_tensor_model_parallel_rank", "get_pipeline_model_parallel_rank",
+    "get_data_parallel_rank",
+    "get_virtual_pipeline_model_parallel_rank",
+    "set_virtual_pipeline_model_parallel_rank",
+    "is_pipeline_first_stage", "is_pipeline_last_stage",
+    "is_rank_in_embedding_group",
+    "get_pipeline_model_parallel_next_rank", "get_pipeline_model_parallel_prev_rank",
+    "get_pipeline_model_parallel_split_rank",
+    "set_pipeline_model_parallel_split_rank",
+    "get_tensor_model_parallel_groups", "get_data_parallel_groups",
+    "get_pipeline_model_parallel_groups", "get_embedding_ranks",
+    "get_rank_info",
+    "PIPE_AXIS", "DATA_AXIS", "TENSOR_AXIS",
+]
+
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"
+TENSOR_AXIS = "tensor"
+
+_MESH: Optional[Mesh] = None
+_VIRTUAL_PP_SIZE: Optional[int] = None
+_VIRTUAL_PP_RANK: Optional[int] = None
+_PP_SPLIT_RANK: Optional[int] = None
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    pipeline_model_parallel_split_rank: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build and install the global mesh (``parallel_state.py:73-247``).
+
+    ``devices`` defaults to ``jax.devices()``; data-parallel size is derived
+    as ``len(devices) / (tp*pp)`` exactly like the reference derives it from
+    world size.
+    """
+    global _MESH, _VIRTUAL_PP_SIZE, _VIRTUAL_PP_RANK, _PP_SPLIT_RANK
+    if devices is None:
+        devices = jax.devices()
+    world = len(devices)
+    tp, pp = tensor_model_parallel_size, pipeline_model_parallel_size
+    if world % (tp * pp) != 0:
+        raise RuntimeError(
+            f"world size ({world}) is not divisible by tensor ({tp}) x "
+            f"pipeline ({pp}) parallel sizes")
+    dp = world // (tp * pp)
+    if virtual_pipeline_model_parallel_size is not None and pp < 2:
+        raise RuntimeError(
+            "pipeline-model-parallel size must be at least 2 with the "
+            "interleaved schedule")
+    # rank layout: tp fastest, then dp, then pp (parallel_state.py:153-247)
+    grid = np.asarray(devices).reshape(pp, dp, tp)
+    _MESH = Mesh(grid, (PIPE_AXIS, DATA_AXIS, TENSOR_AXIS))
+    _VIRTUAL_PP_SIZE = virtual_pipeline_model_parallel_size
+    _VIRTUAL_PP_RANK = 0 if virtual_pipeline_model_parallel_size else None
+    _PP_SPLIT_RANK = pipeline_model_parallel_split_rank
+    return _MESH
+
+
+def model_parallel_is_initialized() -> bool:
+    return _MESH is not None
+
+
+def get_mesh() -> Mesh:
+    if _MESH is None:
+        raise RuntimeError("model parallel is not initialized — call "
+                           "initialize_model_parallel() first")
+    return _MESH
+
+
+def destroy_model_parallel() -> None:
+    """``parallel_state.py:555-580``."""
+    global _MESH, _VIRTUAL_PP_SIZE, _VIRTUAL_PP_RANK, _PP_SPLIT_RANK
+    _MESH = None
+    _VIRTUAL_PP_SIZE = None
+    _VIRTUAL_PP_RANK = None
+    _PP_SPLIT_RANK = None
+
+
+# -- world sizes (static) ----------------------------------------------------
+
+def get_tensor_model_parallel_world_size() -> int:
+    return get_mesh().shape[TENSOR_AXIS]
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return get_mesh().shape[PIPE_AXIS]
+
+
+def get_data_parallel_world_size() -> int:
+    return get_mesh().shape[DATA_AXIS]
+
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _VIRTUAL_PP_SIZE
+
+
+# -- ranks (traced inside shard_map, static int otherwise impossible) --------
+
+def get_tensor_model_parallel_rank():
+    """Traced rank — valid inside ``shard_map`` over the mesh."""
+    return jax.lax.axis_index(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return jax.lax.axis_index(PIPE_AXIS)
+
+
+def get_data_parallel_rank():
+    return jax.lax.axis_index(DATA_AXIS)
+
+
+def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
+    """Host-side scheduling state (``parallel_state.py:475-490``) — the
+    interleaved schedule sets this while building each model chunk."""
+    return _VIRTUAL_PP_RANK
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: Optional[int]) -> None:
+    global _VIRTUAL_PP_RANK
+    _VIRTUAL_PP_RANK = rank
+
+
+def get_pipeline_model_parallel_split_rank() -> Optional[int]:
+    return _PP_SPLIT_RANK
+
+
+def set_pipeline_model_parallel_split_rank(rank: Optional[int]) -> None:
+    global _PP_SPLIT_RANK
+    _PP_SPLIT_RANK = rank
+
+
+# -- stage predicates --------------------------------------------------------
+
+def is_pipeline_first_stage(ignore_virtual: bool = False):
+    """Traced bool inside shard_map (``parallel_state.py:449-460``)."""
+    if not ignore_virtual and _VIRTUAL_PP_SIZE is not None:
+        if _VIRTUAL_PP_RANK != 0:
+            return False
+    return get_pipeline_model_parallel_rank() == 0
+
+
+def is_pipeline_last_stage(ignore_virtual: bool = False):
+    if not ignore_virtual and _VIRTUAL_PP_SIZE is not None:
+        if _VIRTUAL_PP_RANK != (_VIRTUAL_PP_SIZE - 1):
+            return False
+    return (get_pipeline_model_parallel_rank()
+            == get_pipeline_model_parallel_world_size() - 1)
+
+
+def is_rank_in_embedding_group(pipeline_rank) -> bool:
+    """First/last stage tie their embedding grads (``parallel_state.py:215-247``).
+    Takes an explicit (host) pipeline rank."""
+    return pipeline_rank in (0, get_pipeline_model_parallel_world_size() - 1)
+
+
+def get_pipeline_model_parallel_next_rank():
+    """(traced) ``parallel_state.py:524-531``."""
+    pp = get_pipeline_model_parallel_world_size()
+    return (get_pipeline_model_parallel_rank() + 1) % pp
+
+
+def get_pipeline_model_parallel_prev_rank():
+    pp = get_pipeline_model_parallel_world_size()
+    return (get_pipeline_model_parallel_rank() - 1) % pp
+
+
+# -- group enumerations (host-side; for axis_index_groups / debugging) -------
+
+def _global_rank(pp_r: int, dp_r: int, tp_r: int) -> int:
+    tp = get_tensor_model_parallel_world_size()
+    dp = get_data_parallel_world_size()
+    return tp_r + tp * (dp_r + dp * pp_r)
+
+
+def get_tensor_model_parallel_groups() -> List[List[int]]:
+    """Flat-rank groups, same membership as the reference's TP groups
+    (``parallel_state.py:153-247``); usable as ``axis_index_groups`` over a
+    flattened device list."""
+    tp = get_tensor_model_parallel_world_size()
+    dp = get_data_parallel_world_size()
+    pp = get_pipeline_model_parallel_world_size()
+    return [[_global_rank(p, d, t) for t in range(tp)]
+            for p in range(pp) for d in range(dp)]
+
+
+def get_data_parallel_groups() -> List[List[int]]:
+    tp = get_tensor_model_parallel_world_size()
+    dp = get_data_parallel_world_size()
+    pp = get_pipeline_model_parallel_world_size()
+    return [[_global_rank(p, d, t) for d in range(dp)]
+            for p in range(pp) for t in range(tp)]
+
+
+def get_pipeline_model_parallel_groups() -> List[List[int]]:
+    tp = get_tensor_model_parallel_world_size()
+    dp = get_data_parallel_world_size()
+    pp = get_pipeline_model_parallel_world_size()
+    return [[_global_rank(p, d, t) for p in range(pp)]
+            for d in range(dp) for t in range(tp)]
+
+
+def get_embedding_ranks() -> List[List[int]]:
+    """First+last stage per (dp, tp) column (``parallel_state.py:215-247``)."""
+    tp = get_tensor_model_parallel_world_size()
+    dp = get_data_parallel_world_size()
+    pp = get_pipeline_model_parallel_world_size()
+    if pp == 1:
+        return [[_global_rank(0, d, t)] for d in range(dp) for t in range(tp)]
+    return [[_global_rank(0, d, t), _global_rank(pp - 1, d, t)]
+            for d in range(dp) for t in range(tp)]
+
+
+def get_rank_info() -> Tuple[int, int, int, Optional[int]]:
+    """(dp, tp, pp, vpp) sizes for log prefixes
+    (``parallel_state.py:250-259`` returns ranks; sizes here since host code
+    has no single rank under SPMD)."""
+    if not model_parallel_is_initialized():
+        return (1, 1, 1, None)
+    return (get_data_parallel_world_size(),
+            get_tensor_model_parallel_world_size(),
+            get_pipeline_model_parallel_world_size(),
+            _VIRTUAL_PP_SIZE)

@@ -1,0 +1,30 @@
+"""Pipeline parallelism (``reference:apex/transformer/pipeline_parallel/``)."""
+
+from apex_tpu.transformer.pipeline_parallel.microbatches import (  # noqa: F401
+    ConstantNumMicroBatches, NumMicroBatchesCalculator,
+    RampupBatchsizeNumMicroBatches, build_num_microbatches_calculator)
+from apex_tpu.transformer.pipeline_parallel.p2p_communication import (  # noqa: F401
+    rotate_backward, rotate_forward)
+from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func, pipelined_apply)
+from apex_tpu.transformer.pipeline_parallel.utils import (  # noqa: F401
+    average_losses_across_data_parallel_group, get_kth_microbatch,
+    get_ltor_masks_and_position_ids, get_num_microbatches,
+    setup_microbatch_calculator, update_num_microbatches)
+
+__all__ = [
+    "get_forward_backward_func", "pipelined_apply",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
+    "rotate_forward", "rotate_backward",
+    "ConstantNumMicroBatches", "RampupBatchsizeNumMicroBatches",
+    "NumMicroBatchesCalculator", "build_num_microbatches_calculator",
+    "setup_microbatch_calculator", "get_num_microbatches",
+    "update_num_microbatches", "get_kth_microbatch",
+    "average_losses_across_data_parallel_group",
+    "get_ltor_masks_and_position_ids",
+]

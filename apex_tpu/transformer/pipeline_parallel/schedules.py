@@ -1,0 +1,324 @@
+"""Pipeline-parallel forward/backward schedules.
+
+Reference: ``reference:apex/transformer/pipeline_parallel/schedules/`` —
+``get_forward_backward_func`` (:__init__.py:22) dispatching between
+no-pipelining (:fwd_bwd_no_pipelining.py:31-103), 1F1B without interleaving
+(:fwd_bwd_pipelining_without_interleaving.py:155-345) and interleaved
+virtual-pipeline 1F1B (:fwd_bwd_pipelining_with_interleaving.py:25-375).
+
+TPU redesign. The reference drives each microbatch's fwd/bwd from Python
+with explicit NCCL p2p — impossible and unnecessary under jit. Here a
+schedule is a *traced program*: a ``lax.scan`` over pipeline ticks inside
+``shard_map`` over the ``pipe`` axis, with one ``ppermute`` rotation per
+tick. Differentiating the scan yields the backward pipeline automatically
+(the transpose of ``ppermute`` is the reverse rotation; the reversed scan
+replays the cooldown/steady/warmup structure), so ONE code path serves
+forward-only and forward+backward — the reference's 340-line warmup/steady/
+cooldown bookkeeping is the autodiff of this scan. Activation memory is
+O(ticks) per stage by default; pass ``remat=True`` to rematerialize each
+tick in backward (``jax.checkpoint``), the analog of the reference's
+activation-checkpoint + ``free_output_tensor`` tricks
+(:schedules/common.py:198-249).
+
+The stage function must be *stage-uniform* (same jaxpr on every device) and
+branch on the traced stage index for first/last specifics — the SPMD analog
+of ``build_model``'s pre_process/post_process flags
+(:schedules/common.py:29-148).
+
+Microbatch m enters stage 0 at tick m and exits stage S-1 (chunk vpp-1) at
+tick m + L - 1 (L = S*vpp global stages); total ticks = M + L - 1. Bubble
+ticks process zeros and are masked out of the loss.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import PIPE_AXIS
+from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
+    rotate_forward)
+
+
+def _cast_to_vma(x: jnp.ndarray, vma: frozenset) -> jnp.ndarray:
+    """Upcast ``x`` to be device-varying over exactly the axes in ``vma``
+    (idempotent). Over-varying would be semantically safe but makes AD insert
+    spurious cross-replica psums (counting replicated losses once per
+    replica), so the scan carry is normalized to the *minimal* vma the stage
+    body produces — found by fixed-point iteration with ``eval_shape``."""
+    cur = getattr(jax.typeof(x), "vma", frozenset())
+    missing = tuple(a for a in vma if a not in cur)
+    if missing:
+        x = jax.lax.pcast(x, missing, to="varying")
+    return x
+
+__all__ = [
+    "get_forward_backward_func",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
+    "pipelined_apply",
+]
+
+
+# ---------------------------------------------------------------------------
+# no pipelining: scan over microbatches, grad accumulation
+# ---------------------------------------------------------------------------
+
+def forward_backward_no_pipelining(
+    forward_step_func: Callable,
+    batch: Any,
+    params: Any,
+    *,
+    forward_only: bool = False,
+    grad_scale: Any = 1.0,
+    loss_fn: Optional[Callable] = None,
+    num_model_chunks: Optional[int] = None,
+    remat: bool = False,
+) -> Tuple[jnp.ndarray, Any]:
+    """``fwd_bwd_no_pipelining.py:31-103``: loop microbatches, accumulate.
+
+    ``forward_step_func(params, microbatch) -> loss`` (scalar, already
+    averaged over the microbatch). ``batch`` is a pytree whose leaves have a
+    leading ``num_microbatches`` axis (see
+    :func:`~apex_tpu.transformer.pipeline_parallel.utils.get_kth_microbatch`
+    for slicing helpers). Returns ``(mean_loss, grads_or_None)``; grads are
+    averaged over microbatches, matching the reference's grad-sync-at-end
+    semantics (the no_sync context of :77-85 — accumulation happens locally,
+    one sync afterwards by the caller's DDP).
+
+    When ``loss_fn`` is given, the *pipelined* call shape is accepted instead
+    so :func:`get_forward_backward_func` call sites are uniform across
+    pipeline sizes: ``forward_step_func(params, x, stage_index)`` is the
+    whole model (the single stage of a pp=1 run), applied microbatch-wise,
+    and ``loss_fn(y, m)`` the head. ``num_model_chunks`` must then be None
+    or 1.
+    """
+    if loss_fn is not None:
+        if num_model_chunks not in (None, 1):
+            raise ValueError("pp=1 runs have a single model chunk")
+        stage_fn = forward_step_func
+        if remat:
+            stage_fn = jax.checkpoint(stage_fn)
+
+        def uniform_step(params, mb_with_index):
+            mb, m = mb_with_index
+            return loss_fn(stage_fn(params, mb, 0), m)
+
+        n = jax.tree_util.tree_leaves(batch)[0].shape[0]
+        batch = (batch, jnp.arange(n))
+        forward_step_func = uniform_step
+
+    def one(params, mb):
+        if forward_only:
+            return forward_step_func(params, mb), None
+        loss, grads = jax.value_and_grad(
+            lambda p: forward_step_func(p, mb) * grad_scale)(params)
+        return loss / grad_scale, grads
+
+    def scan_body(acc, mb):
+        loss, grads = one(params, mb)
+        acc_loss, acc_grads = acc
+        if grads is not None:
+            acc_grads = jax.tree_util.tree_map(jnp.add, acc_grads, grads)
+        return (acc_loss + loss, acc_grads), None
+
+    n_micro = jax.tree_util.tree_leaves(batch)[0].shape[0]
+    zero_grads = None if forward_only else jax.tree_util.tree_map(
+        lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params)
+    (total_loss, total_grads), _ = jax.lax.scan(
+        scan_body, (jnp.asarray(0.0, jnp.float32), zero_grads), batch)
+    mean_loss = total_loss / n_micro
+    if forward_only:
+        return mean_loss, None
+    grads = jax.tree_util.tree_map(
+        lambda g: (g / (n_micro * grad_scale)).astype(jnp.float32), total_grads)
+    return mean_loss, grads
+
+
+# ---------------------------------------------------------------------------
+# pipelined forward (shared by both pipelined schedules)
+# ---------------------------------------------------------------------------
+
+def pipelined_apply(
+    stage_fn: Callable,
+    stage_params: Any,
+    microbatches: jnp.ndarray,
+    *,
+    num_chunks: int = 1,
+    remat: bool = False,
+    last_stage_fn: Optional[Callable] = None,
+) -> jnp.ndarray:
+    """Run ``microbatches`` through the virtual pipeline; returns the
+    per-microbatch outputs of the final global stage, shape ``(M, ...)``.
+
+    Must be called inside ``shard_map`` with the ``pipe`` axis bound.
+
+    - ``stage_fn(chunk_params, x, global_stage) -> y`` — uniform stage body;
+      ``global_stage`` is a traced int in ``[0, S*num_chunks)``.
+    - ``stage_params``: pytree whose leaves are stacked ``(num_chunks, ...)``
+      — this device's chunks (Megatron layout: chunk c on device d is global
+      stage ``c*S + d``,
+      ``fwd_bwd_pipelining_with_interleaving.py:122-131``).
+    - ``microbatches``: ``(M, ...)`` fed to global stage 0; activations keep
+      this trailing shape through every stage.
+    - ``last_stage_fn(y, m_index) -> out`` — applied to the final stage's
+      output (e.g. loss head); defaults to identity.
+    """
+    S = jax.lax.axis_size(PIPE_AXIS)
+    rank = jax.lax.axis_index(PIPE_AXIS)
+    M = microbatches.shape[0]
+    L = S * num_chunks
+    T = M + L - 1
+    act_shape = microbatches.shape[1:]
+    act_dtype = microbatches.dtype
+
+    def chunk_params_at(c: int):
+        return jax.tree_util.tree_map(
+            lambda p: jax.lax.index_in_dim(p, c, 0, keepdims=False),
+            stage_params)
+
+    def tick(buf, t):
+        # buf: (num_chunks, *act_shape) — input activation per local chunk
+        outs = []
+        for c in range(num_chunks):
+            x = buf[c]
+            if c == 0:
+                # global stage 0 = device 0 chunk 0 consumes fresh microbatch
+                fresh = jax.lax.dynamic_index_in_dim(
+                    microbatches, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+                x = jnp.where(rank == 0, fresh, x)
+            g_stage = c * S + rank
+            fn = stage_fn
+            if remat:
+                fn = jax.checkpoint(stage_fn, static_argnums=())
+            y = fn(chunk_params_at(c), x, g_stage)
+            outs.append(y.astype(act_dtype))
+        stacked = jnp.stack(outs)  # (num_chunks, *act_shape)
+        # rotate all chunk outputs to the next device
+        received = rotate_forward(stacked)
+        # wrap rule: device 0's chunk c>0 consumes last device's chunk c-1
+        new_buf = [jnp.zeros(act_shape, act_dtype)] * num_chunks
+        for c in range(num_chunks):
+            if c == 0:
+                new_buf[0] = received[0]  # overwritten by fresh on rank 0
+            else:
+                new_buf[c] = jnp.where(rank == 0, received[c - 1], received[c])
+        # final-stage output this tick (device S-1, chunk num_chunks-1)
+        final_out = outs[num_chunks - 1]
+        return jnp.stack(new_buf), final_out
+
+    # fixed-point the carry's varying-axes set: the stage body may add axes
+    # (e.g. a TP bias makes activations tensor-varying)
+    zeros = jnp.zeros((num_chunks,) + act_shape, act_dtype)
+    carry_vma = frozenset({PIPE_AXIS})
+    for _ in range(4):
+        init = _cast_to_vma(zeros, carry_vma)
+        out_vma = jax.eval_shape(
+            lambda b: tick(b, jnp.asarray(0))[0], init).vma
+        if out_vma <= carry_vma:
+            break
+        carry_vma = carry_vma | out_vma
+
+    def tick_stable(buf, t):
+        new_buf, final_out = tick(buf, t)
+        return _cast_to_vma(new_buf, carry_vma), final_out
+
+    _, final_outs = jax.lax.scan(tick_stable, init, jnp.arange(T))
+
+    # final stage emits microbatch m at tick m + L - 1; broadcast the last
+    # device's outputs over the pipe axis (masked psum) so every stage
+    # returns the same — replicated — result
+    outs = jax.lax.dynamic_slice_in_dim(final_outs, L - 1, M, axis=0)
+    outs = jax.lax.psum(jnp.where(rank == S - 1, outs, jnp.zeros_like(outs)),
+                        PIPE_AXIS)
+    if last_stage_fn is not None:
+        outs = jax.vmap(last_stage_fn)(outs, jnp.arange(M))
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# pipelined schedules (loss + grads)
+# ---------------------------------------------------------------------------
+
+def _pipelined_fwd_bwd(stage_fn, loss_fn, stage_params, microbatches,
+                       num_chunks, forward_only, remat, grad_scale):
+    """Shared driver: loss = mean over microbatches of
+    ``loss_fn(final_stage_output, m)``, computed at the last stage and
+    psum-shared over ``pipe``; grads via AD through the scan."""
+    def total_loss(params):
+        # pipelined_apply already broadcasts the final stage's outputs over
+        # the pipe axis, so the loss is replicated by construction
+        outs = pipelined_apply(stage_fn, params, microbatches,
+                               num_chunks=num_chunks, remat=remat)
+        m = microbatches.shape[0]
+        losses = jax.vmap(loss_fn)(outs, jnp.arange(m))
+        return jnp.mean(losses)
+
+    if forward_only:
+        return total_loss(stage_params), None
+    loss, grads = jax.value_and_grad(
+        lambda p: total_loss(p) * grad_scale)(stage_params)
+    grads = jax.tree_util.tree_map(
+        lambda g: (g / grad_scale).astype(jnp.float32), grads)
+    return loss / grad_scale, grads
+
+
+def forward_backward_pipelining_without_interleaving(
+    forward_step_func: Callable,
+    batch: jnp.ndarray,
+    params: Any,
+    *,
+    loss_fn: Callable,
+    forward_only: bool = False,
+    remat: bool = False,
+    grad_scale: Any = 1.0,
+):
+    """1F1B-equivalent schedule (``fwd_bwd_pipelining_without_interleaving.py:155-345``).
+
+    ``forward_step_func(stage_params, x, stage_index) -> y`` is the uniform
+    stage body; ``loss_fn(final_output, microbatch_index) -> scalar``.
+    ``params`` leaves must NOT carry a chunk axis (single chunk per stage).
+    Returns ``(mean_loss, grads)`` — grads for this device's stage params.
+    """
+    chunked = jax.tree_util.tree_map(lambda p: p[None], params)
+    loss, grads = _pipelined_fwd_bwd(
+        forward_step_func, loss_fn, chunked, batch, 1, forward_only, remat,
+        grad_scale)
+    if grads is not None:
+        grads = jax.tree_util.tree_map(lambda g: g[0], grads)
+    return loss, grads
+
+
+def forward_backward_pipelining_with_interleaving(
+    forward_step_func: Callable,
+    batch: jnp.ndarray,
+    params: Any,
+    *,
+    loss_fn: Callable,
+    num_model_chunks: int,
+    forward_only: bool = False,
+    remat: bool = False,
+    grad_scale: Any = 1.0,
+):
+    """Interleaved virtual-pipeline schedule
+    (``fwd_bwd_pipelining_with_interleaving.py:25-375``): each device holds
+    ``num_model_chunks`` stage chunks, Megatron layout (chunk c on device d =
+    global stage ``c*S+d``). ``params`` leaves carry a leading
+    ``(num_model_chunks, ...)`` axis."""
+    return _pipelined_fwd_bwd(
+        forward_step_func, loss_fn, params, batch, num_model_chunks,
+        forward_only, remat, grad_scale)
+
+
+def get_forward_backward_func(virtual_pipeline_model_parallel_size: Optional[int],
+                              pipeline_model_parallel_size: int):
+    """Dispatch (``schedules/__init__.py:22``)."""
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None:
+            return forward_backward_pipelining_with_interleaving
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining

@@ -1,0 +1,173 @@
+"""Pipeline-parallel utilities.
+
+Reference: ``reference:apex/transformer/pipeline_parallel/utils.py`` —
+microbatch-calculator global (:58-121), batch slicing (:122-140), params l2
+norm across model-parallel ranks (:213-239), DP loss averaging (:242-250),
+memory report (:253-263), ltor masks/position ids (:303+).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import DATA_AXIS
+from apex_tpu.transformer.pipeline_parallel.microbatches import (
+    build_num_microbatches_calculator)
+
+__all__ = [
+    "setup_microbatch_calculator", "get_num_microbatches",
+    "get_current_global_batch_size", "update_num_microbatches",
+    "get_micro_batch_size", "get_kth_microbatch", "listify_model",
+    "average_losses_across_data_parallel_group", "report_memory",
+    "get_ltor_masks_and_position_ids", "calc_params_l2_norm",
+    "unwrap_model",
+]
+
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+_GLOBAL_AUTORESUME = None
+
+
+def setup_microbatch_calculator(rank: int, rampup_batch_size: Optional[List[int]],
+                                global_batch_size: int, micro_batch_size: int,
+                                data_parallel_size: int) -> None:
+    """:58-90 — installs the process-global calculator once."""
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    if _GLOBAL_NUM_MICROBATCHES_CALCULATOR is not None:
+        raise RuntimeError("num microbatches calculator is already initialized.")
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size)
+
+
+def _calc():
+    if _GLOBAL_NUM_MICROBATCHES_CALCULATOR is None:
+        raise RuntimeError("microbatch calculator is not initialized")
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+
+
+def get_num_microbatches() -> int:
+    return _calc().get()
+
+
+def get_current_global_batch_size() -> int:
+    return _calc().get_current_global_batch_size()
+
+
+def get_micro_batch_size() -> int:
+    return _calc().micro_batch_size
+
+
+def update_num_microbatches(consumed_samples: int,
+                            consistency_check: bool = True) -> None:
+    _calc().update(consumed_samples, consistency_check)
+
+
+def destroy_microbatch_calculator() -> None:
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+
+
+def get_kth_microbatch(batch: Any, k) -> Any:
+    """:122-140 — slice microbatch k out of leaves shaped
+    ``(num_micro * micro_bs, ...)``."""
+    mbs = get_micro_batch_size()
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.dynamic_slice_in_dim(x, k * mbs, mbs, axis=0), batch)
+
+
+def listify_model(model: Any) -> List[Any]:
+    return model if isinstance(model, list) else [model]
+
+
+def unwrap_model(model, module_instances=()):
+    """API-compat: no wrapper modules exist here, returns input."""
+    return model
+
+
+def average_losses_across_data_parallel_group(losses: Sequence[jnp.ndarray]
+                                              ) -> jnp.ndarray:
+    """:242-250 — pmean of the stacked losses over the data axis (call inside
+    shard_map)."""
+    stacked = jnp.stack([jnp.asarray(l, jnp.float32) for l in losses])
+    return jax.lax.pmean(stacked, DATA_AXIS)
+
+
+def report_memory(name: str) -> str:
+    """:253-263 — device memory report (TPU: per-device allocation stats)."""
+    lines = [f"[{name}] memory (MB)"]
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+            used = stats.get("bytes_in_use", 0) / 2**20
+            peak = stats.get("peak_bytes_in_use", 0) / 2**20
+            lines.append(f"  {d}: in_use {used:.1f} | peak {peak:.1f}")
+        except Exception:
+            lines.append(f"  {d}: memory_stats unavailable")
+    report = "\n".join(lines)
+    print(report, flush=True)
+    return report
+
+
+def get_ltor_masks_and_position_ids(
+    data: jnp.ndarray,
+    eod_token: int,
+    reset_position_ids: bool = False,
+    reset_attention_mask: bool = False,
+    eod_mask_loss: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """:303+ — causal mask, loss mask, position ids for a ``(b, s)`` batch.
+
+    The document-reset variants (splitting attention at EOD tokens) are
+    expressed with cumulative EOD counts instead of the reference's Python
+    loop over micro-batches — same results, traceable.
+    Returns ``attention_mask (b,1,s,s) bool (True = masked)``,
+    ``loss_mask (b,s) f32``, ``position_ids (b,s) i32``.
+    """
+    b, s = data.shape
+    row = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+    causal_keep = col <= row  # lower triangular
+    keep = jnp.broadcast_to(causal_keep, (b, s, s))
+
+    loss_mask = jnp.ones((b, s), jnp.float32)
+    if eod_mask_loss:
+        loss_mask = jnp.where(data == eod_token, 0.0, loss_mask)
+
+    position_ids = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+
+    if reset_position_ids or reset_attention_mask:
+        # document id = number of EODs strictly before this position
+        is_eod = (data == eod_token).astype(jnp.int32)
+        doc_id = jnp.cumsum(is_eod, axis=1) - is_eod  # eod belongs to its doc
+        if reset_attention_mask:
+            same_doc = doc_id[:, :, None] == doc_id[:, None, :]
+            keep = keep & same_doc
+        if reset_position_ids:
+            # position within document: index - index of doc start
+            idx = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+            # start index of this position's doc = first index with same doc_id
+            doc_start = jax.vmap(
+                lambda d: jnp.min(
+                    jnp.where(d[None, :] == d[:, None],
+                              jnp.arange(s, dtype=jnp.int32)[None, :], s),
+                    axis=1))(doc_id)
+            position_ids = idx - doc_start
+
+    attention_mask = ~keep[:, None, :, :]  # True = masked
+    return attention_mask, loss_mask, position_ids
+
+
+def calc_params_l2_norm(params: Any, axis_names: Sequence[str] = ("tensor",)
+                        ) -> jnp.ndarray:
+    """:213-239 — L2 norm of all params across model-parallel shards (call
+    inside shard_map; psum over the model axes of the local square-sums).
+    The reference filters TP-duplicated params; here params are stored
+    sharded, so every element is counted exactly once."""
+    sq = sum(jnp.sum(jnp.asarray(p).astype(jnp.float32) ** 2)
+             for p in jax.tree_util.tree_leaves(params))
+    for ax in axis_names:
+        sq = jax.lax.psum(sq, ax)
+    return jnp.sqrt(sq)

@@ -1,0 +1,50 @@
+"""Batch broadcast across the tensor-parallel group.
+
+Reference: ``reference:apex/transformer/tensor_parallel/data.py`` —
+``broadcast_data`` (:80+) sends the rank-0 batch dict (sizes first, then one
+flattened i64 payload) to all TP ranks so every rank of a TP group consumes
+identical data.
+
+TPU version: inside ``shard_map``, rank 0's values are distributed with a
+masked ``psum`` (contributions from other ranks zeroed) — one collective,
+same result. Under GSPMD jit the same guarantee usually comes for free by
+replicating the batch over the tensor axis; this explicit form exists for
+shard_map code paths and parity tests.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+
+__all__ = ["broadcast_data", "broadcast_from_tensor_parallel_rank0"]
+
+
+def broadcast_from_tensor_parallel_rank0(x: jnp.ndarray) -> jnp.ndarray:
+    """Every TP rank gets rank 0's value (masked-psum broadcast)."""
+    rank = jax.lax.axis_index(TENSOR_AXIS)
+    contrib = jnp.where(rank == 0, x, jnp.zeros_like(x))
+    return jax.lax.psum(contrib, TENSOR_AXIS)
+
+
+def broadcast_data(keys: Sequence[str], data: Dict[str, jnp.ndarray],
+                   datatype=None) -> Dict[str, jnp.ndarray]:
+    """``broadcast_data(keys, data, dtype)`` parity: returns a dict where
+    every key holds rank-0's tensor. ``datatype`` casts like the reference's
+    check_data_types."""
+    out = {}
+    for k in keys:
+        v = data[k]
+        if datatype is not None:
+            v = v.astype(datatype)
+        # ints must ride the psum as numbers; bool promoted
+        if v.dtype == jnp.bool_:
+            out[k] = broadcast_from_tensor_parallel_rank0(
+                v.astype(jnp.int32)).astype(jnp.bool_)
+        else:
+            out[k] = broadcast_from_tensor_parallel_rank0(v)
+    return out

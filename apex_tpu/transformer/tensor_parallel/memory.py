@@ -1,0 +1,71 @@
+"""Reusable memory buffers — API-compat layer.
+
+Reference: ``reference:apex/transformer/tensor_parallel/memory.py:35-146`` —
+``MemoryBuffer`` hands out zero-copy views of one preallocated flat tensor
+(used for checkpointed activations), ``RingMemBuffer`` rotates over N of
+them.
+
+On TPU/XLA, buffer reuse is the compiler's job (donation + liveness
+analysis); a Python-side preallocated buffer cannot alias XLA temporaries.
+These classes keep the API (some Megatron-derived code instantiates them)
+with functional semantics: ``get`` returns a correctly-shaped zero view.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+__all__ = ["MemoryBuffer", "RingMemBuffer", "allocate_mem_buff"]
+
+
+class MemoryBuffer:
+    def __init__(self, name: str, numel: int, dtype, track_usage: bool = False):
+        self.name = name
+        self.numel = numel
+        self.dtype = dtype
+        self._start = 0
+        self.in_use_value = 0
+        self.total_value = 0
+
+    def reset(self) -> None:
+        self._start = 0
+
+    def is_in_use(self) -> bool:
+        return self._start > 0
+
+    def numel_in_use(self) -> int:
+        return self._start
+
+    def add(self, shape: Tuple[int, ...]) -> jnp.ndarray:
+        numel = 1
+        for d in shape:
+            numel *= int(d)
+        if self._start + numel > self.numel:
+            raise RuntimeError(f"memory buffer {self.name} overflow")
+        self._start += numel
+        return jnp.zeros(shape, self.dtype)
+
+    def get_data(self) -> jnp.ndarray:
+        return jnp.zeros((self.numel,), self.dtype)
+
+
+class RingMemBuffer:
+    def __init__(self, name: str, num_buffers: int, numel: int, dtype,
+                 track_usage: bool = False):
+        self.num_buffers = num_buffers
+        self.buffers = [MemoryBuffer(f"{name} {i}", numel, dtype, track_usage)
+                        for i in range(num_buffers)]
+        self._index = -1
+
+    def get_next_buffer(self) -> MemoryBuffer:
+        self._index = (self._index + 1) % self.num_buffers
+        buf = self.buffers[self._index]
+        buf.reset()
+        return buf
+
+
+def allocate_mem_buff(name: str, numel: int, dtype, track_usage: bool = False
+                      ) -> MemoryBuffer:
+    return MemoryBuffer(name, numel, dtype, track_usage)

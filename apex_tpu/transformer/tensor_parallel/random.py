@@ -1,0 +1,110 @@
+"""Model-parallel RNG streams + activation checkpointing.
+
+Reference: ``reference:apex/transformer/tensor_parallel/random.py`` —
+``CudaRNGStatesTracker`` (:120-193) maintains named CUDA RNG states so TP
+ranks share a "model-parallel" stream (same dropout inside a TP-sharded
+layer) while keeping distinct data-parallel streams;
+``model_parallel_cuda_manual_seed`` (:200-230) lays the seeds out as
+``tp_seed = seed + 2718 + tp_rank``, ``dp_seed = seed``; and
+``CheckpointFunction`` (:233-304) re-forks the RNG in backward so recomputed
+dropout masks match the forward.
+
+JAX redesign: RNG is explicit keys, so the tracker stores named ``PRNGKey``
+streams and ``fork`` hands out a fresh fold. Recompute-with-same-randomness
+is automatic under ``jax.checkpoint`` because keys are *inputs* — the entire
+stash/restore dance of :246-290 disappears, which is the point of
+re-designing rather than porting. ``get_states``/``set_states`` keep the
+checkpointability of :140-151.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "RNGStatesTracker", "get_rng_tracker", "model_parallel_seed",
+    "checkpoint", "_MODEL_PARALLEL_RNG_TRACKER_NAME",
+]
+
+_MODEL_PARALLEL_RNG_TRACKER_NAME = "model-parallel-rng"
+_TENSOR_SEED_OFFSET = 2718  # reference:tensor_parallel/random.py:200-230
+
+
+class RNGStatesTracker:
+    """Named PRNG streams (``random.py:120-193``). ``fork(name)`` yields a
+    fresh subkey each call and advances the stream, mirroring how forking
+    CUDA RNG state advances it."""
+
+    def __init__(self):
+        self.states_: Dict[str, jax.Array] = {}
+
+    def reset(self) -> None:
+        self.states_ = {}
+
+    def get_states(self) -> Dict[str, jax.Array]:
+        return dict(self.states_)
+
+    def set_states(self, states: Dict[str, jax.Array]) -> None:
+        self.states_ = dict(states)
+
+    def add(self, name: str, seed) -> None:
+        if name in self.states_:
+            raise Exception(f"rng state {name} already exists")
+        if isinstance(seed, int):
+            key = jax.random.PRNGKey(seed)
+        else:
+            key = seed
+        self.states_[name] = key
+
+    def make_key(self, name: str = _MODEL_PARALLEL_RNG_TRACKER_NAME
+                 ) -> jax.Array:
+        """Split off a subkey and advance the named stream."""
+        if name not in self.states_:
+            raise Exception(f"rng state {name} is not added")
+        key, sub = jax.random.split(self.states_[name])
+        self.states_[name] = key
+        return sub
+
+    @contextlib.contextmanager
+    def fork(self, name: str = _MODEL_PARALLEL_RNG_TRACKER_NAME):
+        """Context-manager API parity with ``random.py:171-193``; yields the
+        subkey to thread into dropout/init calls."""
+        yield self.make_key(name)
+
+
+_GLOBAL_TRACKER = RNGStatesTracker()
+
+
+def get_rng_tracker() -> RNGStatesTracker:
+    """``get_cuda_rng_tracker`` equivalent."""
+    return _GLOBAL_TRACKER
+
+
+def model_parallel_seed(seed: int, tensor_rank: Optional[int] = None
+                        ) -> None:
+    """``model_parallel_cuda_manual_seed`` (:200-230): installs the default
+    (data-parallel) stream at ``seed`` and the model-parallel stream at
+    ``seed + 2718 + tp_rank``.
+
+    ``tensor_rank`` may be a traced rank (inside shard_map) — keys are built
+    with ``fold_in`` so tracing works.
+    """
+    tracker = get_rng_tracker()
+    tracker.reset()
+    base = jax.random.PRNGKey(seed)
+    tracker.add("default", base)
+    if tensor_rank is None:
+        tp_key = jax.random.PRNGKey(seed + _TENSOR_SEED_OFFSET)
+    else:
+        tp_key = jax.random.fold_in(
+            jax.random.PRNGKey(seed + _TENSOR_SEED_OFFSET), tensor_rank)
+    tracker.add(_MODEL_PARALLEL_RNG_TRACKER_NAME, tp_key)
+
+
+# Activation checkpointing: recompute in backward; RNG correctness is free
+# because keys are explicit inputs (vs CheckpointFunction random.py:233-304).
+checkpoint = jax.checkpoint

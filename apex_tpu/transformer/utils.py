@@ -1,0 +1,45 @@
+"""Shared transformer utilities (``reference:apex/transformer/utils.py``)."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ensure_divisibility", "divide", "split_tensor_along_last_dim",
+           "VocabUtility"]
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    assert numerator % denominator == 0, (
+        f"{numerator} is not divisible by {denominator}")
+
+
+def divide(numerator: int, denominator: int) -> int:
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_along_last_dim(x: jnp.ndarray, num_partitions: int
+                                ) -> Tuple[jnp.ndarray, ...]:
+    """``reference:apex/transformer/utils.py`` — equal chunks of the last dim."""
+    last = divide(x.shape[-1], num_partitions)
+    return tuple(jnp.split(x, num_partitions, axis=-1))
+
+
+class VocabUtility:
+    """Vocab shard index ranges (``reference:apex/transformer/tensor_parallel/utils.py``)."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(
+            per_partition_vocab_size: int, rank, world_size: int):
+        first = rank * per_partition_vocab_size
+        return first, first + per_partition_vocab_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(global_vocab_size: int, rank,
+                                           world_size: int):
+        per = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per, rank, world_size)
