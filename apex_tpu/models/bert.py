@@ -1,0 +1,100 @@
+"""Standalone BERT (``reference:apex/transformer/testing/standalone_bert.py``,
+218 LoC): bidirectional encoder sharing the GPT block structure (the
+reference builds both from the same ParallelTransformer), plus token-type
+embeddings, a pooler, and the MLM binary head. Padding masks ride the flash
+kernel's additive bias instead of the seqlen-capped fused softmax."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models.gpt import GPTConfig, GPTModel
+from apex_tpu.ops.flash_attention import flash_attention
+from apex_tpu.utils.vma import scan_stable_vma
+
+__all__ = ["BertConfig", "BertModel"]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig(GPTConfig):
+    num_token_types: int = 2
+    add_pooler: bool = True
+
+
+class BertModel(GPTModel):
+    def __init__(self, config: BertConfig):
+        super().__init__(config)
+
+    def init(self, key: jax.Array) -> dict:
+        cfg = self.cfg
+        k0, k1, k2 = jax.random.split(key, 3)
+        params = super().init(k0)
+        params["embedding"]["tokentype"] = (
+            0.02 * jax.random.normal(
+                k1, (cfg.num_token_types, cfg.hidden_size))
+        ).astype(cfg.params_dtype)
+        if cfg.add_pooler:
+            params["pooler"] = {
+                "weight": (0.02 * jax.random.normal(
+                    k2, (cfg.hidden_size, cfg.hidden_size))
+                ).astype(cfg.params_dtype),
+                "bias": jnp.zeros(cfg.hidden_size, cfg.params_dtype)}
+        return params
+
+    def _attention(self, lp, x, bias=None):
+        cfg = self.cfg
+        b, s, _ = x.shape
+        local_heads = cfg.num_attention_heads // cfg.tensor_model_parallel_size
+        qkv, _ = self.qkv(lp["qkv"], x)
+        qkv = qkv.reshape(b, s, local_heads, 3 * cfg.head_dim)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (jnp.transpose(t, (0, 2, 1, 3)) for t in (q, k, v))
+        ctx = flash_attention(q, k, v, bias=bias, causal=False,
+                              use_pallas=cfg.use_flash)
+        ctx = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(b, s, -1)
+        out, _ = self.proj(lp["proj"], ctx)
+        return out
+
+    def _layer(self, lp, x, bias=None):
+        x = x + self._attention(lp, self._ln(lp["ln1"], x), bias)
+        x = x + self._mlp(lp, self._ln(lp["ln2"], x))
+        return x
+
+    def encode(self, params: dict, tokens: jnp.ndarray,
+               token_types: Optional[jnp.ndarray] = None,
+               attention_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        """``attention_mask``: (b, s) with 1 = attend, 0 = pad."""
+        cfg = self.cfg
+        h = self.embed(params, tokens)
+        if token_types is not None:
+            h = h + jnp.take(params["embedding"]["tokentype"], token_types,
+                             axis=0).astype(h.dtype)
+        bias = None
+        if attention_mask is not None:
+            bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
+                             -10000.0).astype(jnp.float32)
+
+        layer_fn = self._layer
+        if cfg.remat:
+            layer_fn = jax.checkpoint(layer_fn)
+
+        def body(x, lp):
+            return layer_fn(lp, x, bias), None
+
+        h, _ = scan_stable_vma(body, h, params["layers"])
+        return self._ln(params["final_ln"], h)
+
+    def pool(self, params: dict, h: jnp.ndarray) -> jnp.ndarray:
+        """tanh-dense over the [CLS] position (standalone_bert pooler)."""
+        cls = h[:, 0]
+        w = params["pooler"]["weight"].astype(cls.dtype)
+        b = params["pooler"]["bias"].astype(cls.dtype)
+        return jnp.tanh(cls @ w.T + b)
+
+    def __call__(self, params, tokens, token_types=None, attention_mask=None):
+        h = self.encode(params, tokens, token_types, attention_mask)
+        return self.logits(params, h)

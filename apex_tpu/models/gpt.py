@@ -1,0 +1,254 @@
+"""Standalone GPT — the flagship model / "model zoo" fixture.
+
+Reference: ``reference:apex/transformer/testing/standalone_gpt.py`` (1,524
+LoC) — ``ParallelMLP`` (:236), ``ParallelAttention`` (:285),
+``ParallelTransformerLayer`` (:577), ``ParallelTransformer`` (:713),
+``Embedding`` (:1000), ``TransformerLanguageModel`` (:1150), ``GPTModel``
+(:1440). Same architecture (pre-LN GPT-2 style, learned positions, tied
+output embedding, vocab-parallel loss), rebuilt TPU-first:
+
+- attention is the Pallas flash kernel (no seqlen-2048 fused-softmax cap);
+- QKV/proj/MLP are Column/Row-parallel over the ``tensor`` axis with heads
+  sharded tp-ways, exactly the reference's sharding;
+- homogeneous layers are stacked and scanned (``lax.scan``) so compile time
+  is O(1) in depth — the idiomatic XLA shape for deep stacks — with optional
+  per-layer remat (the reference's activation checkpointing);
+- everything is bf16 compute / fp32 params by default (amp O2 semantics).
+
+Works single-chip (tp=1, no mesh needed), under ``shard_map`` for TP, and as
+a pipeline ``stage_fn`` (see :meth:`GPTModel.stage_fn`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.normalization import fused_layer_norm_affine
+from apex_tpu.ops.flash_attention import flash_attention
+from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+from apex_tpu.transformer import tensor_parallel as tp_mod
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy)
+from apex_tpu.transformer.tensor_parallel.layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    _local_shard, init_method_normal)
+from apex_tpu.utils.vma import scan_stable_vma
+
+__all__ = ["GPTConfig", "GPTModel"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    """Sizes follow the Megatron arg names (``testing/arguments.py``)."""
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 1024
+    ffn_hidden_size: Optional[int] = None  # default 4*hidden
+    tensor_model_parallel_size: int = 1
+    params_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    init_method_std: float = 0.02
+    layernorm_epsilon: float = 1e-5
+    remat: bool = False          # per-layer activation checkpointing
+    use_flash: Optional[bool] = None  # None = auto by shape/backend
+
+    @property
+    def ffn(self) -> int:
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+class GPTModel:
+    """Param-factory GPT. ``init(key)`` -> params pytree; ``__call__`` gives
+    logits; ``loss`` gives the LM loss (vocab-parallel when tp>1)."""
+
+    def __init__(self, config: GPTConfig):
+        cfg = config
+        if cfg.hidden_size % cfg.num_attention_heads:
+            raise ValueError("hidden_size must divide num_attention_heads")
+        if cfg.num_attention_heads % cfg.tensor_model_parallel_size:
+            raise ValueError("heads must divide tp size")
+        self.cfg = cfg
+        tp = cfg.tensor_model_parallel_size
+        init = init_method_normal(cfg.init_method_std)
+        # output-layer init scaled by sqrt(2*layers) (standalone_gpt.py
+        # scaled_init_method pattern)
+        out_init = init_method_normal(
+            cfg.init_method_std / math.sqrt(2.0 * cfg.num_layers))
+        self.embedding = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size, init_method=init,
+            params_dtype=cfg.params_dtype, world_size=tp)
+        self.qkv = ColumnParallelLinear(
+            cfg.hidden_size, 3 * cfg.hidden_size, gather_output=False,
+            init_method=init, params_dtype=cfg.params_dtype, world_size=tp)
+        self.proj = RowParallelLinear(
+            cfg.hidden_size, cfg.hidden_size, input_is_parallel=True,
+            init_method=out_init, params_dtype=cfg.params_dtype, world_size=tp)
+        self.fc1 = ColumnParallelLinear(
+            cfg.hidden_size, cfg.ffn, gather_output=False, init_method=init,
+            params_dtype=cfg.params_dtype, world_size=tp)
+        self.fc2 = RowParallelLinear(
+            cfg.ffn, cfg.hidden_size, input_is_parallel=True,
+            init_method=out_init, params_dtype=cfg.params_dtype, world_size=tp)
+
+    # -- params -------------------------------------------------------------
+
+    def _layer_init(self, key: jax.Array) -> dict:
+        cfg = self.cfg
+        k = jax.random.split(key, 4)
+        h = cfg.hidden_size
+        return {
+            "ln1": {"weight": jnp.ones(h, cfg.params_dtype),
+                    "bias": jnp.zeros(h, cfg.params_dtype)},
+            "qkv": self.qkv.init(k[0]),
+            "proj": self.proj.init(k[1]),
+            "ln2": {"weight": jnp.ones(h, cfg.params_dtype),
+                    "bias": jnp.zeros(h, cfg.params_dtype)},
+            "fc1": self.fc1.init(k[2]),
+            "fc2": self.fc2.init(k[3]),
+        }
+
+    def init(self, key: jax.Array) -> dict:
+        cfg = self.cfg
+        kw, kp, kl = jax.random.split(key, 3)
+        layer_keys = jax.random.split(kl, cfg.num_layers)
+        layers = jax.vmap(self._layer_init)(layer_keys)
+        return {
+            "embedding": {
+                "word": self.embedding.init(kw),
+                "position": init_method_normal(cfg.init_method_std)(
+                    kp, (cfg.max_position_embeddings, cfg.hidden_size)
+                ).astype(cfg.params_dtype),
+            },
+            "layers": layers,  # leaves stacked (num_layers, ...)
+            "final_ln": {"weight": jnp.ones(cfg.hidden_size, cfg.params_dtype),
+                         "bias": jnp.zeros(cfg.hidden_size, cfg.params_dtype)},
+        }
+
+    # -- blocks -------------------------------------------------------------
+
+    def _ln(self, p: dict, x: jnp.ndarray) -> jnp.ndarray:
+        # mixed-dtype rule: bf16 activations, fp32 ln params -> bf16 out
+        out = fused_layer_norm_affine(
+            x, p["weight"].astype(x.dtype), p["bias"].astype(x.dtype),
+            self.cfg.hidden_size, eps=self.cfg.layernorm_epsilon)
+        return out
+
+    def _attention(self, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.cfg
+        b, s, _ = x.shape
+        local_heads = cfg.num_attention_heads // cfg.tensor_model_parallel_size
+        qkv, _ = self.qkv(lp["qkv"], x)  # (b, s, 3*h/tp)
+        qkv = qkv.reshape(b, s, local_heads, 3 * cfg.head_dim)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = jnp.transpose(q, (0, 2, 1, 3))  # (b, nh, s, d)
+        k = jnp.transpose(k, (0, 2, 1, 3))
+        v = jnp.transpose(v, (0, 2, 1, 3))
+        ctx = flash_attention(q, k, v, causal=True,
+                              use_pallas=cfg.use_flash)
+        ctx = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(b, s, -1)
+        out, _ = self.proj(lp["proj"], ctx)
+        return out
+
+    def _mlp(self, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
+        h, _ = self.fc1(lp["fc1"], x)
+        h = jax.nn.gelu(h, approximate=True)
+        out, _ = self.fc2(lp["fc2"], h)
+        return out
+
+    def _layer(self, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
+        x = x + self._attention(lp, self._ln(lp["ln1"], x))
+        x = x + self._mlp(lp, self._ln(lp["ln2"], x))
+        return x
+
+    # -- forward ------------------------------------------------------------
+
+    def embed(self, params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.cfg
+        h = self.embedding(params["embedding"]["word"], tokens)
+        pos = params["embedding"]["position"][: tokens.shape[1]]
+        return (h + pos).astype(cfg.compute_dtype)
+
+    def transform(self, params: dict, x: jnp.ndarray) -> jnp.ndarray:
+        """Run the layer stack (scan) + final LN."""
+        layer_fn = self._layer
+        if self.cfg.remat:
+            layer_fn = jax.checkpoint(layer_fn)
+
+        def body(x, lp):
+            return layer_fn(lp, x), None
+
+        x, _ = scan_stable_vma(body, x, params["layers"])
+        return self._ln(params["final_ln"], x)
+
+    def logits(self, params: dict, x: jnp.ndarray) -> jnp.ndarray:
+        """Tied output embedding (standalone_gpt.py parallel_lm_logits):
+        returns vocab-parallel logits (local shard) when tp>1."""
+        w = _local_shard(params["embedding"]["word"]["weight"],
+                         self.cfg.tensor_model_parallel_size)
+        return jax.lax.dot_general(
+            x, w.astype(x.dtype), (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    def __call__(self, params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
+        return self.logits(params, self.transform(params, self.embed(params, tokens)))
+
+    def loss(self, params: dict, tokens: jnp.ndarray,
+             targets: jnp.ndarray, loss_mask: Optional[jnp.ndarray] = None
+             ) -> jnp.ndarray:
+        """LM loss; vocab-parallel CE over the tensor axis when tp>1
+        (``standalone_gpt.py`` post_language_model_processing)."""
+        logits = self(params, tokens)
+        if self.cfg.tensor_model_parallel_size > 1:
+            per_tok = vocab_parallel_cross_entropy(logits, targets)
+        else:
+            per_tok = softmax_cross_entropy_loss(
+                logits.reshape(-1, logits.shape[-1]), targets.reshape(-1),
+                padding_idx=None, half_to_float=True
+            ).reshape(targets.shape)
+        if loss_mask is not None:
+            return jnp.sum(per_tok * loss_mask) / jnp.maximum(
+                jnp.sum(loss_mask), 1.0)
+        return jnp.mean(per_tok)
+
+    # -- pipeline integration ----------------------------------------------
+
+    def stage_fn(self, num_stages: int):
+        """Returns ``(stage_fn, split_params)`` for the pipeline schedules:
+        the layer stack is split into ``num_stages`` equal chunks; embedding
+        and head stay outside (run them in ``loss_fn`` / before feeding
+        microbatches), matching build_model's pre/post_process split
+        (``schedules/common.py:29-148``)."""
+        if self.cfg.num_layers % num_stages:
+            raise ValueError("num_layers must divide num_stages")
+        per = self.cfg.num_layers // num_stages
+
+        def stage(stage_params: dict, x: jnp.ndarray, stage_idx) -> jnp.ndarray:
+            layer_fn = self._layer
+            if self.cfg.remat:
+                layer_fn = jax.checkpoint(layer_fn)
+
+            def body(x, lp):
+                return layer_fn(lp, x), None
+
+            x, _ = scan_stable_vma(body, x, stage_params)
+            return x
+
+        def split_params(params: dict):
+            """(num_layers, ...) -> (num_stages, per, ...) stage stacking."""
+            return jax.tree_util.tree_map(
+                lambda p: p.reshape(num_stages, per, *p.shape[1:]),
+                params["layers"])
+
+        return stage, split_params

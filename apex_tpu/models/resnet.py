@@ -1,0 +1,186 @@
+"""ResNet-50 — the amp/DDP convergence-path model.
+
+Reference usage: ``reference:examples/imagenet/main_amp.py`` (torchvision
+resnet50 under amp O0-O3 + apex DDP, the L1 test model) and the fused
+bottleneck of ``reference:apex/contrib/bottleneck/bottleneck.py:512`` (cuDNN
+conv+bias+relu fusion + halo-exchange spatial parallelism).
+
+TPU design: NHWC convs via ``lax.conv_general_dilated`` (XLA fuses
+bias+BN+ReLU epilogues natively — the entire point of fast_bottleneck is a
+compiler built-in here), BN is :class:`apex_tpu.parallel.SyncBatchNorm` so
+the same model runs single-chip or cross-replica synced, bf16 compute with
+fp32 BN stats (amp O2's keep_batchnorm_fp32).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import BatchNormState, SyncBatchNorm
+
+__all__ = ["ResNetConfig", "ResNet50", "Bottleneck"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)  # resnet-50
+    width: int = 64
+    compute_dtype: Any = jnp.bfloat16
+    params_dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None  # "data" => SyncBN
+    bn_momentum: float = 0.1
+
+
+def _conv_init(key, shape, dtype):
+    # he/kaiming fan-out normal, torchvision's conv init
+    fan_out = shape[0] * shape[1] * shape[3]
+    std = (2.0 / fan_out) ** 0.5
+    return std * jax.random.normal(key, shape, dtype)
+
+
+def _conv(x, w, stride=1, padding="SAME"):
+    # no preferred_element_type: the MXU accumulates bf16 convs in fp32
+    # natively, and a widened output dtype breaks the conv transpose rule
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+class Bottleneck:
+    """1x1 -> 3x3 -> 1x1 with residual; BN+ReLU fused by XLA (the
+    fast_bottleneck block)."""
+
+    expansion = 4
+
+    def __init__(self, cfg: ResNetConfig, in_ch: int, ch: int, stride: int):
+        self.cfg = cfg
+        self.in_ch, self.ch, self.stride = in_ch, ch, stride
+        self.out_ch = ch * self.expansion
+        self.bn = SyncBatchNorm(1, axis_name=cfg.bn_axis_name,
+                                channel_axis=-1, momentum=cfg.bn_momentum)
+        self.downsample = stride != 1 or in_ch != self.out_ch
+
+    def _bn_init(self, n):
+        return ({"weight": jnp.ones(n, self.cfg.params_dtype),
+                 "bias": jnp.zeros(n, self.cfg.params_dtype)},
+                BatchNormState(jnp.zeros(n, jnp.float32),
+                               jnp.ones(n, jnp.float32),
+                               jnp.asarray(0, jnp.int32)))
+
+    def init(self, key):
+        cfg = self.cfg
+        ks = jax.random.split(key, 4)
+        params, state = {}, {}
+        params["conv1"] = _conv_init(ks[0], (1, 1, self.in_ch, self.ch),
+                                     cfg.params_dtype)
+        params["bn1"], state["bn1"] = self._bn_init(self.ch)
+        params["conv2"] = _conv_init(ks[1], (3, 3, self.ch, self.ch),
+                                     cfg.params_dtype)
+        params["bn2"], state["bn2"] = self._bn_init(self.ch)
+        params["conv3"] = _conv_init(ks[2], (1, 1, self.ch, self.out_ch),
+                                     cfg.params_dtype)
+        params["bn3"], state["bn3"] = self._bn_init(self.out_ch)
+        if self.downsample:
+            params["conv_ds"] = _conv_init(
+                ks[3], (1, 1, self.in_ch, self.out_ch), cfg.params_dtype)
+            params["bn_ds"], state["bn_ds"] = self._bn_init(self.out_ch)
+        return params, state
+
+    def _bn(self, p, s, x, training, z=None, relu=True):
+        out, new_s = _bn_apply(self.cfg, p, s, x, training, z=z,
+                               fuse_relu=relu)
+        return out, new_s
+
+    def __call__(self, params, state, x, training=True):
+        new_state = {}
+        h = _conv(x, params["conv1"])
+        h, new_state["bn1"] = self._bn(params["bn1"], state["bn1"], h, training)
+        h = _conv(h, params["conv2"], stride=self.stride)
+        h, new_state["bn2"] = self._bn(params["bn2"], state["bn2"], h, training)
+        h = _conv(h, params["conv3"])
+        if self.downsample:
+            sc = _conv(x, params["conv_ds"], stride=self.stride)
+            sc, new_state["bn_ds"] = self._bn(params["bn_ds"], state["bn_ds"],
+                                              sc, training, relu=False)
+        else:
+            sc = x
+        # fused add+relu epilogue (batch_norm_add_relu of groupbn)
+        h, new_state["bn3"] = self._bn(params["bn3"], state["bn3"], h,
+                                       training, z=sc)
+        return h, new_state
+
+
+def _bn_apply(cfg, p, s, x, training, z=None, fuse_relu=True):
+    from apex_tpu.parallel.sync_batchnorm import sync_batch_norm
+    return sync_batch_norm(
+        x, p["weight"], p["bias"], s, training=training,
+        momentum=cfg.bn_momentum, channel_axis=-1,
+        axis_name=cfg.bn_axis_name, z=z, fuse_relu=fuse_relu)
+
+
+class ResNet50:
+    """NHWC ResNet-v1.5 (stride-2 in the 3x3, torchvision convention)."""
+
+    def __init__(self, config: ResNetConfig = ResNetConfig()):
+        self.cfg = config
+        self.blocks = []
+        in_ch = config.width
+        for i, n in enumerate(config.stage_sizes):
+            ch = config.width * (2 ** i)
+            stage = []
+            for j in range(n):
+                stride = 2 if (i > 0 and j == 0) else 1
+                blk = Bottleneck(config, in_ch, ch, stride)
+                stage.append(blk)
+                in_ch = blk.out_ch
+            self.blocks.append(stage)
+        self.feat_ch = in_ch
+
+    def init(self, key):
+        cfg = self.cfg
+        k_stem, k_fc, *k_blocks = jax.random.split(
+            key, 2 + sum(cfg.stage_sizes))
+        params = {"stem": {
+            "conv": _conv_init(k_stem, (7, 7, 3, cfg.width), cfg.params_dtype)}}
+        state = {"stem": {}}
+        params["stem"]["bn"], state["stem"]["bn"] = \
+            Bottleneck(cfg, 3, cfg.width, 1)._bn_init(cfg.width)
+        ki = iter(k_blocks)
+        for i, stage in enumerate(self.blocks):
+            for j, blk in enumerate(stage):
+                p, s = blk.init(next(ki))
+                params[f"b{i}_{j}"] = p
+                state[f"b{i}_{j}"] = s
+        bound = 1.0 / (self.feat_ch ** 0.5)
+        params["fc"] = {
+            "weight": jax.random.uniform(
+                k_fc, (cfg.num_classes, self.feat_ch), cfg.params_dtype,
+                -bound, bound),
+            "bias": jnp.zeros(cfg.num_classes, cfg.params_dtype)}
+        return params, state
+
+    def __call__(self, params, state, x, training=True):
+        """x: (n, h, w, 3) NHWC; returns (logits fp32, new_state)."""
+        cfg = self.cfg
+        x = x.astype(cfg.compute_dtype)
+        new_state = {"stem": {}}
+        h = jax.lax.conv_general_dilated(
+            x, params["stem"]["conv"].astype(x.dtype), (2, 2),
+            [(3, 3), (3, 3)], dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        h, new_state["stem"]["bn"] = _bn_apply(
+            cfg, params["stem"]["bn"], state["stem"]["bn"], h, training)
+        h = jax.lax.reduce_window(
+            h, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+            [(0, 0), (1, 1), (1, 1), (0, 0)])
+        for i, stage in enumerate(self.blocks):
+            for j, blk in enumerate(stage):
+                h, new_state[f"b{i}_{j}"] = blk(
+                    params[f"b{i}_{j}"], state[f"b{i}_{j}"], h, training)
+        h = jnp.mean(h.astype(jnp.float32), axis=(1, 2))
+        w = params["fc"]["weight"].astype(jnp.float32)
+        return h @ w.T + params["fc"]["bias"], new_state

@@ -39,21 +39,12 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.transformer.parallel_state import PIPE_AXIS
+from apex_tpu.utils.vma import cast_to_vma
 from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
     rotate_forward)
 
 
-def _cast_to_vma(x: jnp.ndarray, vma: frozenset) -> jnp.ndarray:
-    """Upcast ``x`` to be device-varying over exactly the axes in ``vma``
-    (idempotent). Over-varying would be semantically safe but makes AD insert
-    spurious cross-replica psums (counting replicated losses once per
-    replica), so the scan carry is normalized to the *minimal* vma the stage
-    body produces — found by fixed-point iteration with ``eval_shape``."""
-    cur = getattr(jax.typeof(x), "vma", frozenset())
-    missing = tuple(a for a in vma if a not in cur)
-    if missing:
-        x = jax.lax.pcast(x, missing, to="varying")
-    return x
+
 
 __all__ = [
     "get_forward_backward_func",
@@ -216,7 +207,7 @@ def pipelined_apply(
     zeros = jnp.zeros((num_chunks,) + act_shape, act_dtype)
     carry_vma = frozenset({PIPE_AXIS})
     for _ in range(4):
-        init = _cast_to_vma(zeros, carry_vma)
+        init = cast_to_vma(zeros, carry_vma)
         out_vma = jax.eval_shape(
             lambda b: tick(b, jnp.asarray(0))[0], init).vma
         if out_vma <= carry_vma:
@@ -225,7 +216,7 @@ def pipelined_apply(
 
     def tick_stable(buf, t):
         new_buf, final_out = tick(buf, t)
-        return _cast_to_vma(new_buf, carry_vma), final_out
+        return cast_to_vma(new_buf, carry_vma), final_out
 
     _, final_outs = jax.lax.scan(tick_stable, init, jnp.arange(T))
 

@@ -53,6 +53,14 @@ def init_method_normal(sigma: float) -> Callable:
     return init_
 
 
+def _default_tp_world_size() -> int:
+    """TP size from the installed mesh, or 1 when uninitialized (single-chip
+    use without initialize_model_parallel, like torch layers without dist)."""
+    if parallel_state.model_parallel_is_initialized():
+        return parallel_state.get_tensor_model_parallel_world_size()
+    return 1
+
+
 def _dense(x, w_t):
     """x @ w^T with fp32 MXU accumulation (w stored (out, in) like torch)."""
     return jax.lax.dot_general(x, w_t, (((x.ndim - 1,), (1,)), ((), ())),
@@ -103,7 +111,7 @@ class ColumnParallelLinear:
         self.params_dtype = params_dtype
         self.init_method = init_method or init_method_normal(0.02)
         self.world_size = (world_size if world_size is not None
-                           else parallel_state.get_tensor_model_parallel_world_size())
+                           else _default_tp_world_size())
         self.output_size_per_partition = divide(output_size, self.world_size)
 
     def init(self, key: jax.Array) -> dict:
@@ -121,7 +129,8 @@ class ColumnParallelLinear:
     def __call__(self, params: dict, x: jnp.ndarray
                  ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
         w = _local_shard(params["weight"], self.world_size)
-        x = copy_to_tensor_model_parallel_region(x)
+        if self.world_size > 1:
+            x = copy_to_tensor_model_parallel_region(x)
         out = _dense(x, w).astype(x.dtype)
         b = None
         if self.use_bias:
@@ -129,7 +138,7 @@ class ColumnParallelLinear:
             if not self.skip_bias_add:
                 out = out + b.astype(out.dtype)
                 b = None
-        if self.gather_output:
+        if self.gather_output and self.world_size > 1:
             out = gather_from_tensor_model_parallel_region(out)
             if b is not None:
                 b = gather_from_tensor_model_parallel_region(b)
@@ -153,7 +162,7 @@ class RowParallelLinear:
         self.params_dtype = params_dtype
         self.init_method = init_method or init_method_normal(0.02)
         self.world_size = (world_size if world_size is not None
-                           else parallel_state.get_tensor_model_parallel_world_size())
+                           else _default_tp_world_size())
         self.input_size_per_partition = divide(input_size, self.world_size)
 
     def init(self, key: jax.Array) -> dict:
@@ -173,16 +182,21 @@ class RowParallelLinear:
     def __call__(self, params: dict, x: jnp.ndarray
                  ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
         w = _local_shard(params["weight"], self.world_size)
-        if not self.input_is_parallel:
+        if not self.input_is_parallel and self.world_size > 1:
             x = scatter_to_tensor_model_parallel_region(x)
         partial = _dense(x, w).astype(x.dtype)
-        out = reduce_from_tensor_model_parallel_region(partial)
-        b = None
-        if self.use_bias:
-            b = _local_shard(params["bias"], self.world_size)
-            if not self.skip_bias_add:
-                out = out + b.astype(out.dtype)
-                b = None
+        b = _local_shard(params["bias"], self.world_size) if self.use_bias \
+            else None
+        if b is not None and not self.skip_bias_add:
+            # fold b/tp into the pre-psum partial: same forward value, and
+            # the psum transpose hands every rank the same (psum(g)/tp) bias
+            # grad — a rank-local post-reduce add would give each bias copy
+            # a different, 1/tp-scale cotangent and the replicas would drift
+            partial = partial + (b.astype(jnp.float32)
+                                 / self.world_size).astype(partial.dtype)
+            b = None
+        out = (reduce_from_tensor_model_parallel_region(partial)
+               if self.world_size > 1 else partial)
         return out, b
 
 
@@ -199,7 +213,7 @@ class VocabParallelEmbedding:
         self.init_method = init_method or init_method_normal(0.02)
         self.params_dtype = params_dtype
         self.world_size = (world_size if world_size is not None
-                           else parallel_state.get_tensor_model_parallel_world_size())
+                           else _default_tp_world_size())
         self.num_embeddings_per_partition = divide(num_embeddings,
                                                    self.world_size)
 
@@ -212,6 +226,8 @@ class VocabParallelEmbedding:
 
     def __call__(self, params: dict, ids: jnp.ndarray) -> jnp.ndarray:
         w = _local_shard(params["weight"], self.world_size)
+        if self.world_size == 1:
+            return jnp.take(w, ids, axis=0)
         per = self.num_embeddings_per_partition
         start = jax.lax.axis_index(TENSOR_AXIS) * per
         # vocab-range mask (:221-239)

@@ -1,0 +1,51 @@
+"""Varying-manual-axes (VMA) helpers for scans inside ``shard_map``.
+
+Under JAX's VMA type system a ``lax.scan`` carry must keep the same
+varying-axes set every iteration, but a body that uses sharded params (e.g. a
+TP bias add) *adds* axes to its output's set. Over-varying the carry up front
+would be safe for values but makes AD insert spurious cross-replica psums
+(each replica's identical loss counted once per replica), so the right fix is
+the *minimal* fixed point, found by abstract evaluation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["cast_to_vma", "scan_stable_vma"]
+
+
+def cast_to_vma(x: jnp.ndarray, vma: frozenset) -> jnp.ndarray:
+    """Upcast ``x`` to be device-varying over at least ``vma`` (idempotent)."""
+    cur = getattr(jax.typeof(x), "vma", frozenset())
+    missing = tuple(a for a in vma if a not in cur)
+    if missing:
+        x = jax.lax.pcast(x, missing, to="varying")
+    return x
+
+
+def scan_stable_vma(body: Callable, init: Any, xs: Any, max_iters: int = 4):
+    """``lax.scan`` whose carry VMA is fixed-pointed against the body.
+
+    ``body(carry, x) -> (carry, y)`` with a single-array carry.
+    """
+    carry_vma = getattr(jax.typeof(init), "vma", None) or frozenset()
+    for _ in range(max_iters):
+        init_c = cast_to_vma(init, carry_vma)
+        first_x = jax.tree_util.tree_map(
+            lambda v: jax.lax.index_in_dim(v, 0, 0, keepdims=False), xs)
+        out_vma = getattr(jax.eval_shape(lambda c, x: body(c, x)[0],
+                                         init_c, first_x),
+                          "vma", None) or frozenset()
+        if out_vma <= carry_vma:
+            break
+        carry_vma = carry_vma | out_vma
+
+    def stable_body(carry, x):
+        new_c, y = body(carry, x)
+        return cast_to_vma(new_c, carry_vma), y
+
+    return jax.lax.scan(stable_body, cast_to_vma(init, carry_vma), xs)

@@ -1,0 +1,89 @@
+"""Headline benchmark — ImageNet ResNet-50 train-step throughput per chip.
+
+Matches BASELINE.json's metric ("ImageNet RN50 imgs/sec/chip, amp O2+DDP"):
+bf16 compute / fp32 master params (amp O2 semantics), FusedSGD momentum
+(the imagenet example's optimizer), synthetic data (the reference's
+``--prof`` style synthetic path; input pipeline is out of scope for a
+kernel/runtime library benchmark on both sides).
+
+``vs_baseline`` compares against NVIDIA's published DGX-A100
+DeepLearningExamples ResNet-50 AMP number (~2470 imgs/sec per A100), the
+"8xA100 amp-O2+DDP" north-star divided per chip; the reference repo itself
+publishes no numbers (BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.amp.scaler import DynamicLossScale, all_finite
+from apex_tpu.models import ResNet50, ResNetConfig
+from apex_tpu.optimizers import FusedSGD
+
+A100_AMP_RN50_IMGS_PER_SEC = 2470.0  # per-chip baseline (see docstring)
+
+BATCH = 128
+IMG = 224
+WARMUP = 3
+ITERS = 10
+
+
+def main():
+    cfg = ResNetConfig(num_classes=1000, compute_dtype=jnp.bfloat16)
+    model = ResNet50(cfg)
+    params, bn_state = model.init(jax.random.PRNGKey(0))
+    opt = FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+    opt_state = opt.init(params)
+    scaler = DynamicLossScale(init_scale=2.0 ** 12)
+    ls = scaler.init()
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(BATCH, IMG, IMG, 3), jnp.bfloat16)
+    labels = jnp.asarray(rng.randint(0, 1000, BATCH))
+
+    def loss_fn(params, bn_state, scale):
+        logits, new_bn = model(params, bn_state, x, training=True)
+        onehot = jax.nn.one_hot(labels, 1000)
+        loss = -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
+        return loss * scale, (loss, new_bn)
+
+    @jax.jit
+    def step(params, bn_state, opt_state, ls):
+        grads, (loss, new_bn) = jax.grad(loss_fn, has_aux=True)(
+            params, bn_state, ls.loss_scale)
+        grads = scaler.unscale(ls, grads)
+        finite = all_finite(grads)
+        new_ls = scaler.update(ls, finite)
+        params, opt_state = opt.step(grads, opt_state, params,
+                                     grads_finite=finite)
+        return params, new_bn, opt_state, new_ls, loss
+
+    # warmup/compile
+    for _ in range(WARMUP):
+        params, bn_state, opt_state, ls, loss = step(
+            params, bn_state, opt_state, ls)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        params, bn_state, opt_state, ls, loss = step(
+            params, bn_state, opt_state, ls)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "imgs/sec",
+        "vs_baseline": round(imgs_per_sec / A100_AMP_RN50_IMGS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
