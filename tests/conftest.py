@@ -12,22 +12,13 @@ switch platforms via ``jax.config`` — which works any time before the backend
 is first used — rather than via environment variables.
 """
 
-import os
+from apex_tpu.utils.hostmesh import force_virtual_cpu_devices
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+force_virtual_cpu_devices(8)
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
-
-assert jax.device_count() == 8, (
-    f"tests need 8 virtual CPU devices, got {jax.devices()}; was a backend "
-    "already initialized before conftest ran?")
 
 
 def pytest_report_header(config):
