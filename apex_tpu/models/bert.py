@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.models.gpt import GPTConfig, GPTModel
+from apex_tpu.ops.dropout import dropout
 from apex_tpu.ops.flash_attention import flash_attention
 from apex_tpu.utils.vma import scan_stable_vma
 
@@ -45,7 +46,7 @@ class BertModel(GPTModel):
                 "bias": jnp.zeros(cfg.hidden_size, cfg.params_dtype)}
         return params
 
-    def _attention(self, lp, x, bias=None):
+    def _attention(self, lp, x, bias=None, attn_seed=None):
         cfg = self.cfg
         b, s, _ = x.shape
         local_heads = cfg.num_attention_heads // cfg.tensor_model_parallel_size
@@ -53,26 +54,41 @@ class BertModel(GPTModel):
         qkv = qkv.reshape(b, s, local_heads, 3 * cfg.head_dim)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q, k, v = (jnp.transpose(t, (0, 2, 1, 3)) for t in (q, k, v))
+        rate = cfg.attention_dropout if attn_seed is not None else 0.0
         ctx = flash_attention(q, k, v, bias=bias, causal=False,
-                              use_pallas=cfg.use_flash)
+                              use_pallas=cfg.use_flash,
+                              dropout_rate=rate, dropout_seed=attn_seed)
         ctx = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(b, s, -1)
         out, _ = self.proj(lp["proj"], ctx)
         return out
 
-    def _layer(self, lp, x, bias=None):
-        x = x + self._attention(lp, self._ln(lp["ln1"], x), bias)
-        x = x + self._mlp(lp, self._ln(lp["ln2"], x))
-        return x
+    def _layer(self, lp, x, bias=None, lrng=None):
+        cfg = self.cfg
+        attn_seed = lrng["attn_seed"] if lrng is not None else None
+        a = self._attention(lp, self._ln(lp["ln1"], x), bias, attn_seed)
+        if lrng is not None:
+            a = dropout(a, cfg.hidden_dropout, lrng["h1"])
+        x = x + a
+        m = self._mlp(lp, self._ln(lp["ln2"], x))
+        if lrng is not None:
+            m = dropout(m, cfg.hidden_dropout, lrng["h2"])
+        return x + m
 
     def encode(self, params: dict, tokens: jnp.ndarray,
                token_types: Optional[jnp.ndarray] = None,
-               attention_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+               attention_mask: Optional[jnp.ndarray] = None,
+               dropout_rng: Optional[jax.Array] = None) -> jnp.ndarray:
         """``attention_mask``: (b, s) with 1 = attend, 0 = pad."""
         cfg = self.cfg
+        # embedding dropout is applied to the FULL word+pos+tokentype sum
+        # (Megatron Embedding semantics), so embed() runs without dropout
         h = self.embed(params, tokens)
         if token_types is not None:
             h = h + jnp.take(params["embedding"]["tokentype"], token_types,
                              axis=0).astype(h.dtype)
+        if dropout_rng is not None:
+            h = dropout(h, cfg.hidden_dropout,
+                        jax.random.fold_in(dropout_rng, 3))
         bias = None
         if attention_mask is not None:
             bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
@@ -81,11 +97,22 @@ class BertModel(GPTModel):
         layer_fn = self._layer
         if cfg.remat:
             layer_fn = jax.checkpoint(layer_fn)
+        use_dropout = dropout_rng is not None and (
+            cfg.hidden_dropout > 0.0 or cfg.attention_dropout > 0.0)
 
-        def body(x, lp):
-            return layer_fn(lp, x, bias), None
+        if use_dropout:
+            xs = (params["layers"], self._layer_rngs(dropout_rng))
 
-        h, _ = scan_stable_vma(body, h, params["layers"])
+            def body(x, lp_rng):
+                lp, lrng = lp_rng
+                return layer_fn(lp, x, bias, lrng), None
+        else:
+            xs = params["layers"]
+
+            def body(x, lp):
+                return layer_fn(lp, x, bias), None
+
+        h, _ = scan_stable_vma(body, h, xs)
         return self._ln(params["final_ln"], h)
 
     def pool(self, params: dict, h: jnp.ndarray) -> jnp.ndarray:
@@ -95,6 +122,8 @@ class BertModel(GPTModel):
         b = params["pooler"]["bias"].astype(cls.dtype)
         return jnp.tanh(cls @ w.T + b)
 
-    def __call__(self, params, tokens, token_types=None, attention_mask=None):
-        h = self.encode(params, tokens, token_types, attention_mask)
+    def __call__(self, params, tokens, token_types=None, attention_mask=None,
+                 dropout_rng=None):
+        h = self.encode(params, tokens, token_types, attention_mask,
+                        dropout_rng)
         return self.logits(params, h)

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.normalization import fused_layer_norm_affine
+from apex_tpu.ops.dropout import dropout
 from apex_tpu.ops.flash_attention import flash_attention
 from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
 from apex_tpu.transformer import tensor_parallel as tp_mod
@@ -59,6 +60,15 @@ class GPTConfig:
     layernorm_epsilon: float = 1e-5
     remat: bool = False          # per-layer activation checkpointing
     use_flash: Optional[bool] = None  # None = auto by shape/backend
+    # Dropout (standalone_gpt.py attention/hidden dropout; 0.0 = off so
+    # eval-style calls stay deterministic without threading an rng).
+    # Semantics under TP follow the reference's RNG stream layout
+    # (tensor_parallel/random.py:200-230): hidden+embedding dropout draw
+    # from the caller's key (identical across TP ranks — the activations
+    # are replicated), attention-probability dropout folds in the TP rank
+    # (the heads are sharded, each rank's slice gets an independent mask).
+    hidden_dropout: float = 0.0
+    attention_dropout: float = 0.0
 
     @property
     def ffn(self) -> int:
@@ -145,7 +155,8 @@ class GPTModel:
             self.cfg.hidden_size, eps=self.cfg.layernorm_epsilon)
         return out
 
-    def _attention(self, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
+    def _attention(self, lp: dict, x: jnp.ndarray,
+                   attn_seed=None) -> jnp.ndarray:
         cfg = self.cfg
         b, s, _ = x.shape
         local_heads = cfg.num_attention_heads // cfg.tensor_model_parallel_size
@@ -155,8 +166,10 @@ class GPTModel:
         q = jnp.transpose(q, (0, 2, 1, 3))  # (b, nh, s, d)
         k = jnp.transpose(k, (0, 2, 1, 3))
         v = jnp.transpose(v, (0, 2, 1, 3))
+        rate = cfg.attention_dropout if attn_seed is not None else 0.0
         ctx = flash_attention(q, k, v, causal=True,
-                              use_pallas=cfg.use_flash)
+                              use_pallas=cfg.use_flash,
+                              dropout_rate=rate, dropout_seed=attn_seed)
         ctx = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(b, s, -1)
         out, _ = self.proj(lp["proj"], ctx)
         return out
@@ -167,29 +180,72 @@ class GPTModel:
         out, _ = self.fc2(lp["fc2"], h)
         return out
 
-    def _layer(self, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
-        x = x + self._attention(lp, self._ln(lp["ln1"], x))
-        x = x + self._mlp(lp, self._ln(lp["ln2"], x))
-        return x
+    def _layer(self, lp: dict, x: jnp.ndarray, lrng=None) -> jnp.ndarray:
+        cfg = self.cfg
+        attn_seed = lrng["attn_seed"] if lrng is not None else None
+        a = self._attention(lp, self._ln(lp["ln1"], x), attn_seed)
+        if lrng is not None:
+            a = dropout(a, cfg.hidden_dropout, lrng["h1"])
+        x = x + a
+        m = self._mlp(lp, self._ln(lp["ln2"], x))
+        if lrng is not None:
+            m = dropout(m, cfg.hidden_dropout, lrng["h2"])
+        return x + m
+
+    def _layer_rngs(self, dropout_rng: jax.Array) -> dict:
+        """Per-layer dropout randomness, stacked (num_layers, ...) for the
+        scan: attention seeds from the TP-rank-folded stream, hidden keys
+        from the caller's (TP-replicated) stream."""
+        cfg = self.cfg
+        attn_key = dropout_rng
+        if cfg.tensor_model_parallel_size > 1:
+            attn_key = jax.random.fold_in(
+                attn_key, jax.lax.axis_index(TENSOR_AXIS) + 1)
+        seeds = jax.random.randint(
+            jax.random.fold_in(attn_key, 1), (cfg.num_layers,), 0, 1 << 24)
+        hkeys = jax.random.split(jax.random.fold_in(dropout_rng, 2),
+                                 2 * cfg.num_layers)
+        hkeys = hkeys.reshape(cfg.num_layers, 2, *hkeys.shape[1:])
+        return {"attn_seed": seeds, "h1": hkeys[:, 0], "h2": hkeys[:, 1]}
 
     # -- forward ------------------------------------------------------------
 
-    def embed(self, params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
+    def embed(self, params: dict, tokens: jnp.ndarray,
+              dropout_rng: Optional[jax.Array] = None) -> jnp.ndarray:
         cfg = self.cfg
         h = self.embedding(params["embedding"]["word"], tokens)
         pos = params["embedding"]["position"][: tokens.shape[1]]
-        return (h + pos).astype(cfg.compute_dtype)
+        h = (h + pos).astype(cfg.compute_dtype)
+        if dropout_rng is not None:
+            # embedding dropout at the hidden rate (standalone_gpt Embedding)
+            h = dropout(h, cfg.hidden_dropout,
+                        jax.random.fold_in(dropout_rng, 3))
+        return h
 
-    def transform(self, params: dict, x: jnp.ndarray) -> jnp.ndarray:
-        """Run the layer stack (scan) + final LN."""
+    def transform(self, params: dict, x: jnp.ndarray,
+                  dropout_rng: Optional[jax.Array] = None) -> jnp.ndarray:
+        """Run the layer stack (scan) + final LN. ``dropout_rng`` enables
+        train-mode dropout (None = eval/deterministic)."""
+        cfg = self.cfg
         layer_fn = self._layer
-        if self.cfg.remat:
+        if cfg.remat:
             layer_fn = jax.checkpoint(layer_fn)
+        use_dropout = dropout_rng is not None and (
+            cfg.hidden_dropout > 0.0 or cfg.attention_dropout > 0.0)
 
-        def body(x, lp):
-            return layer_fn(lp, x), None
+        if use_dropout:
+            xs = (params["layers"], self._layer_rngs(dropout_rng))
 
-        x, _ = scan_stable_vma(body, x, params["layers"])
+            def body(x, lp_rng):
+                lp, lrng = lp_rng
+                return layer_fn(lp, x, lrng), None
+        else:
+            xs = params["layers"]
+
+            def body(x, lp):
+                return layer_fn(lp, x), None
+
+        x, _ = scan_stable_vma(body, x, xs)
         return self._ln(params["final_ln"], x)
 
     def logits(self, params: dict, x: jnp.ndarray) -> jnp.ndarray:
@@ -201,15 +257,18 @@ class GPTModel:
             x, w.astype(x.dtype), (((x.ndim - 1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    def __call__(self, params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
-        return self.logits(params, self.transform(params, self.embed(params, tokens)))
+    def __call__(self, params: dict, tokens: jnp.ndarray,
+                 dropout_rng: Optional[jax.Array] = None) -> jnp.ndarray:
+        return self.logits(params, self.transform(
+            params, self.embed(params, tokens, dropout_rng), dropout_rng))
 
     def loss(self, params: dict, tokens: jnp.ndarray,
-             targets: jnp.ndarray, loss_mask: Optional[jnp.ndarray] = None
-             ) -> jnp.ndarray:
+             targets: jnp.ndarray, loss_mask: Optional[jnp.ndarray] = None,
+             dropout_rng: Optional[jax.Array] = None) -> jnp.ndarray:
         """LM loss; vocab-parallel CE over the tensor axis when tp>1
-        (``standalone_gpt.py`` post_language_model_processing)."""
-        logits = self(params, tokens)
+        (``standalone_gpt.py`` post_language_model_processing).
+        ``dropout_rng`` enables train-mode dropout."""
+        logits = self(params, tokens, dropout_rng)
         if self.cfg.tensor_model_parallel_size > 1:
             per_tok = vocab_parallel_cross_entropy(logits, targets)
         else:

@@ -6,8 +6,9 @@ TPU equivalents of the reference's kernel-backed op layer
 ``apex/contrib/multihead_attn``, ``apex/mlp``, ``apex/fused_dense``).
 """
 
+from apex_tpu.ops.dropout import dropout  # noqa: F401
 from apex_tpu.ops.flash_attention import (  # noqa: F401
-    flash_attention, mha_reference, supports_flash)
+    dropout_keep_mask, flash_attention, mha_reference, supports_flash)
 from apex_tpu.ops.focal_loss import FocalLoss, focal_loss  # noqa: F401
 from apex_tpu.ops.fused_softmax import (  # noqa: F401
     AttnMaskType, FusedScaleMaskSoftmax, scaled_masked_softmax,

@@ -1,0 +1,31 @@
+"""Inverted dropout (the torch convention the reference models use).
+
+Reference call sites: hidden/embedding dropout in
+``reference:apex/transformer/testing/standalone_gpt.py`` (bias_dropout_add,
+embedding dropout) and the fused attention-probability dropout in
+``reference:apex/contrib/csrc/multihead_attn/dropout.cuh:272`` — the latter
+lives inside :func:`apex_tpu.ops.flash_attention.flash_attention`
+(``dropout_rate``/``dropout_seed``), not here.
+
+Scaling at train time (``x/(1-rate)``), identity at eval, matching
+``torch.nn.functional.dropout``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["dropout"]
+
+
+def dropout(x: jnp.ndarray, rate: float, key: Optional[jax.Array],
+            deterministic: bool = False) -> jnp.ndarray:
+    """Inverted dropout; no-op when ``rate == 0``, ``deterministic``, or
+    ``key is None`` (eval mode)."""
+    if rate == 0.0 or deterministic or key is None:
+        return x
+    keep = jax.random.bernoulli(key, 1.0 - rate, jnp.shape(x))
+    return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))

@@ -13,13 +13,33 @@ innermost; running ``(m, l, acc)`` live in VMEM scratch across kv steps
 (TPU grid execution is sequential per core, the canonical Pallas flash
 pattern). Backward recomputes probabilities from the saved per-row logsumexp
 (same recompute-not-store trade as the CUDA dgrad kernels) in two kernels:
-one gridded over q blocks (dq), one over kv blocks (dk, dv).
+one gridded over q blocks (dq), one over kv blocks (dk, dv). Rows that are
+fully masked out save ``lse = +inf`` so the backward's
+``p = exp(s - lse)`` underflows to exactly zero instead of producing
+``exp(-inf - -inf) = 1`` garbage (ADVICE r1).
 
 ``bias`` is an additive score bias (the general form of the reference's
-padding masks — additive -10000 fills, ``scaled_masked_softmax.h``) and is
-non-differentiable, as in the reference. Dropout inside the kernel (the
-``philox.cuh`` path of fast_multihead_attn) is not implemented yet; apply
-dropout to the output, or pass pre-masked bias for deterministic ablation.
+padding masks — additive -10000 fills, ``scaled_masked_softmax.h``). It is
+kept in its broadcastable shape end to end: broadcast dims map to block
+index 0 in the BlockSpec and broadcasting happens in VMEM, so a padding
+mask ``(b, 1, 1, sk)`` costs O(b·sk) HBM, not O(b·h·sq·sk).
+
+``bias`` gradients: **zero by default** — differentiating through ``bias``
+without passing ``bias_requires_grad=True`` silently yields zeros (the
+padding-mask case, where a gradient is meaningless). For *learned* biases
+(ALiBi slopes, relative-position tables) pass ``bias_requires_grad=True``:
+a dedicated kernel recomputes the score cotangent ds blockwise and
+accumulates its sum over the broadcast dims directly into a bias-shaped
+output — dbias costs O(|bias|) HBM, never the full score matrix.
+
+Dropout runs *inside* the kernel (the ``philox.cuh`` path of
+fast_multihead_attn / ``dropout.cuh:272``): a counter-based hash RNG keyed
+on ``(seed, batch·head, global row, global col)`` generates the keep mask
+blockwise, so the backward regenerates the identical mask from the same
+counters with no mask storage — the Philox design, in backend-portable
+uint32 ops (``pltpu.prng_*`` has no CPU interpret path). Masks are applied
+to the normalized probabilities (scaled 1/(1-rate)); the softmax normalizer
+uses the undropped probabilities, matching the reference kernels.
 """
 
 from __future__ import annotations
@@ -30,12 +50,58 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention", "mha_reference", "supports_flash"]
+__all__ = ["flash_attention", "mha_reference", "supports_flash",
+           "dropout_keep_mask"]
 
 NEG_INF = -1e30
+
+# murmur3 finalizer constants — numpy scalars embed as immediates in the
+# kernel jaxpr (jnp scalars would be captured consts, which Pallas rejects)
+_MIX1 = np.uint32(0x85EBCA6B)
+_MIX2 = np.uint32(0xC2B2AE35)
+_GOLD = np.uint32(0x9E3779B1)
+
+
+def _mix32(x):
+    x = x ^ (x >> 16)
+    x = x * _MIX1
+    x = x ^ (x >> 13)
+    x = x * _MIX2
+    return x ^ (x >> 16)
+
+
+def _keep_mask(seed_f, bh, i, j, block_q, block_k, rate):
+    """Counter-based dropout keep mask for score block (i, j) of batch-head
+    ``bh`` — the ``philox.cuh`` analog. Depends only on the *global*
+    (seed, bh, row, col) coordinates, so every kernel (fwd, dq, dkv, dbias)
+    and the host-side test reference regenerate the identical mask."""
+    # f32 -> i32 -> u32: Mosaic has no direct float->unsigned cast
+    seed = seed_f.astype(jnp.int32).astype(jnp.uint32)
+    row = (i * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)).astype(jnp.uint32)
+    col = (j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)).astype(jnp.uint32)
+    h = _mix32(seed ^ _mix32(jnp.asarray(bh).astype(jnp.uint32)))
+    x = _mix32(h ^ _mix32(row * _GOLD + col))
+    # compare in the integer domain (Mosaic has no unsigned->float cast):
+    # keep iff the top-24-bit draw >= rate * 2^24
+    thresh = np.int32(int(rate * (1 << 24)))
+    return (x >> np.uint32(8)).astype(jnp.int32) >= thresh
+
+
+def dropout_keep_mask(seed, b, h, sq, sk, rate):
+    """Host/XLA version of the in-kernel dropout mask (for parity tests and
+    the non-Pallas fallback): (b, h, sq, sk) boolean keep mask identical to
+    what the kernels generate for ``seed``."""
+    seed_f = (jnp.asarray(seed) % (1 << 24)).astype(jnp.float32)
+    bh_ids = jnp.arange(b * h, dtype=jnp.int32)
+    masks = jax.vmap(
+        lambda bh: _keep_mask(seed_f, bh, 0, 0, sq, sk, rate))(bh_ids)
+    return masks.reshape(b, h, sq, sk)
 
 
 def supports_flash(sq: int, sk: int, d: int, block_q: int, block_k: int) -> bool:
@@ -47,9 +113,13 @@ def supports_flash(sq: int, sk: int, d: int, block_q: int, block_k: int) -> bool
 
 
 def mha_reference(q, k, v, bias=None, causal=False,
-                  softmax_scale: Optional[float] = None):
+                  softmax_scale: Optional[float] = None,
+                  dropout_rate: float = 0.0, dropout_seed=None):
     """Plain-XLA attention; the parity reference for the kernel (the role of
-    the Python attention in ``reference:apex/contrib/test/fmha/test_fmha.py``)."""
+    the Python attention in ``reference:apex/contrib/test/fmha/test_fmha.py``).
+    With ``dropout_rate > 0`` it applies the *same* counter-based mask as the
+    Pallas kernels, so fallback and kernel paths agree bitwise in expectation
+    and exactly for a given seed."""
     if softmax_scale is None:
         softmax_scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
@@ -62,6 +132,10 @@ def mha_reference(q, k, v, bias=None, causal=False,
         col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
         s = jnp.where(col > row + (sk - sq), NEG_INF, s)
     p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate > 0.0:
+        b, h, sq, sk = p.shape
+        keep = dropout_keep_mask(dropout_seed, b, h, sq, sk, dropout_rate)
+        p = jnp.where(keep, p, 0.0) / (1.0 - dropout_rate)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
                       preferred_element_type=jnp.float32).astype(q.dtype)
 
@@ -70,10 +144,10 @@ def mha_reference(q, k, v, bias=None, causal=False,
 # forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k,
-                n_kv, offset):
-    i, j = pl.program_id(1), pl.program_id(2)
+                n_kv, offset, dropout_rate):
+    bh, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
 
     @pl.when(j == 0)
     def _():
@@ -92,7 +166,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if bias_ref is not None:
-            s = s + bias_ref[0].astype(jnp.float32)
+            s = s + bias_ref[0, 0]  # (1|bq, bk) broadcasts over the block
         if causal:
             row = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -102,9 +176,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
+        if causal:
+            # rows fully masked within a running block have m_new == NEG_INF,
+            # so exp(s - m_new) == 1 on masked entries — zero them explicitly
+            p = jnp.where(col > row + offset, 0.0, p)
         corr = jnp.exp(m_prev - m_new)
+        # softmax normalizer uses the UNdropped probabilities
         l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=1, keepdims=True)
         m_ref[:] = m_new
+        if dropout_rate > 0.0:
+            keep = _keep_mask(seed_ref[0], bh, i, j, block_q, block_k,
+                              dropout_rate)
+            p = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_rate))
         pv = jax.lax.dot_general(p.astype(v_ref.dtype), v_ref[0],
                                  (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -113,19 +196,63 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
     @pl.when(j == n_kv - 1)
     def _():
         l = l_ref[:]
-        # fully-masked rows (l==0) produce 0 output, not NaN
+        # fully-masked rows (l==0): 0 output, and lse=+inf so the backward's
+        # exp(s - lse) underflows to 0 for every entry of the row
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0] = m_ref[:] + jnp.log(safe_l)
+        lse_ref[0] = jnp.where(l == 0.0, jnp.inf,
+                               m_ref[:] + jnp.log(safe_l))
 
 
 # ---------------------------------------------------------------------------
 # backward kernels
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc, *, scale, causal, block_q, block_k, n_kv, offset):
-    i, j = pl.program_id(1), pl.program_id(2)
+def _recompute_p_ds(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, lse_ref,
+                    delta_ref, bh, i, j, *, scale, causal, block_q, block_k,
+                    offset, dropout_rate):
+    """Shared backward recompute: p = exp(s - lse) with causal masking
+    (including the explicit p-zeroing of masked entries — masked rows of a
+    running block have lse = +inf so exp underflows, and causally-masked
+    entries are zeroed directly), plus ds = p * (dp_eff - delta).
+
+    With dropout the identical keep mask is regenerated from the counters:
+    ``p_eff`` (for dv) is the dropped-and-rescaled probability, and
+    ``dp_eff = keep ⊙ dp/(1-rate)`` feeds ds — the exact transpose of the
+    forward's dropout-after-normalizer placement.
+    """
+    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if bias_ref is not None:
+        s = s + bias_ref[0, 0]  # (1|bq, bk) broadcasts over the block
+    if causal:
+        row = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        col = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(col > row + offset, NEG_INF, s)
+    p = jnp.exp(s - lse_ref[0])
+    if causal:
+        p = jnp.where(col > row + offset, 0.0, p)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    if dropout_rate > 0.0:
+        keep = _keep_mask(seed_ref[0], bh, i, j, block_q, block_k,
+                          dropout_rate)
+        inv = 1.0 / (1.0 - dropout_rate)
+        p_eff = jnp.where(keep, p, 0.0) * inv
+        dp_eff = jnp.where(keep, dp, 0.0) * inv
+    else:
+        p_eff, dp_eff = p, dp
+    ds = p * (dp_eff - delta_ref[0])
+    return p_eff, ds
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, dq_acc, *, scale, causal, block_q,
+                   block_k, n_kv, offset, dropout_rate):
+    bh, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
 
     @pl.when(j == 0)
     def _():
@@ -135,21 +262,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _():
-        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if bias_ref is not None:
-            s = s + bias_ref[0].astype(jnp.float32)
-        if causal:
-            row = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            col = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(col > row + offset, NEG_INF, s)
-        p = jnp.exp(s - lse_ref[0])
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0])
+        _, ds = _recompute_p_ds(q_ref, k_ref, v_ref, bias_ref, seed_ref,
+                                do_ref, lse_ref, delta_ref, bh, i, j,
+                                scale=scale, causal=causal, block_q=block_q,
+                                block_k=block_k, offset=offset,
+                                dropout_rate=dropout_rate)
+        k = k_ref[0]
         dq_acc[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -159,9 +277,49 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    block_q, block_k, n_q, offset):
+def _dbias_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, lse_ref,
+                  delta_ref, db_ref, *, scale, causal, block_q, block_k,
+                  swap, offset, dropout_rate, bh_fn):
+    """Accumulate dbias = ds summed over the bias's broadcast dims.
+
+    Grid is ``(kept_bh, a, b, r)`` with the reduced bh slices ``r``
+    innermost (and, when the bias broadcasts over sq, the q-blocks too via
+    ``swap``), so the output tile is revisited on consecutive steps and the
+    reduction accumulates in VMEM — dbias costs O(|bias|) HBM, never the
+    full (b·h, sq, sk) score matrix."""
+    g, a, b_, r = (pl.program_id(n) for n in range(4))
+    bh = bh_fn(g, r)  # program_id must be read at kernel top level, not
+    # inside a pl.when branch (interpret mode cannot substitute it there)
+    if swap:       # bias broadcast over sq: reduce over q-blocks as well
+        j, i = a, b_
+        first = jnp.logical_and(i == 0, r == 0)
+    else:
+        i, j = a, b_
+        first = r == 0
+
+    @pl.when(first)
+    def _():
+        db_ref[...] = jnp.zeros_like(db_ref)
+
+    run = (j * block_k <= i * block_q + block_q - 1 + offset) if causal else True
+
+    @pl.when(run)
+    def _():
+        _, ds = _recompute_p_ds(q_ref, k_ref, v_ref, bias_ref, seed_ref,
+                                do_ref, lse_ref, delta_ref, bh,
+                                i, j, scale=scale, causal=causal,
+                                block_q=block_q, block_k=block_k,
+                                offset=offset, dropout_rate=dropout_rate)
+        if swap:
+            db_ref[0, 0] += jnp.sum(ds, axis=0, keepdims=True)
+        else:
+            db_ref[0, 0] += ds
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale,
+                    causal, block_q, block_k, n_q, offset, dropout_rate):
+    bh = pl.program_id(0)
     j, i = pl.program_id(1), pl.program_id(2)  # kv outer, q inner
 
     @pl.when(i == 0)
@@ -173,24 +331,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _():
-        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if bias_ref is not None:
-            s = s + bias_ref[0].astype(jnp.float32)
-        if causal:
-            row = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            col = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(col > row + offset, NEG_INF, s)
-        p = jnp.exp(s - lse_ref[0])
+        p, ds = _recompute_p_ds(q_ref, k_ref, v_ref, bias_ref, seed_ref,
+                                do_ref, lse_ref, delta_ref, bh, i, j,
+                                scale=scale, causal=causal, block_q=block_q,
+                                block_k=block_k, offset=offset,
+                                dropout_rate=dropout_rate)
+        q, do = q_ref[0], do_ref[0]
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0])
         dk_acc[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -209,11 +358,39 @@ def _interp() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _fwd_pallas(q3, k3, v3, bias3, *, scale, causal, block_q, block_k):
+def _bias_spec(bias4, h, block_q, block_k, *, swapped):
+    """BlockSpec for the 4D broadcastable bias ``(bb, hb, sqb, sk)`` where
+    ``bb``/``hb``/``sqb`` are each 1 or full: broadcast dims map to block 0
+    and the kernel broadcasts in VMEM (ADVICE r1: never materialize the
+    full (b·h, sq, sk) bias in HBM)."""
+    bb, hb, sqb, _ = bias4.shape
+    bq = block_q if sqb > 1 else 1
+
+    def imap_fwd(b, i, j):
+        return (b // h if bb > 1 else 0, b % h if hb > 1 else 0,
+                i if sqb > 1 else 0, j)
+
+    def imap_swapped(b, j, i):
+        return (b // h if bb > 1 else 0, b % h if hb > 1 else 0,
+                i if sqb > 1 else 0, j)
+
+    return pl.BlockSpec((1, 1, bq, block_k),
+                        imap_swapped if swapped else imap_fwd,
+                        memory_space=pltpu.VMEM)
+
+
+def _seed_spec():
+    """Dropout seed: a (1,) fp32 scalar in SMEM, shared by every block."""
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _fwd_pallas(q3, k3, v3, bias4, seed, h, *, scale, causal, block_q,
+                block_k, dropout_rate):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     n_q, n_kv = sq // block_q, sk // block_k
-    has_bias = bias3 is not None
+    has_bias = bias4 is not None
+    has_drop = dropout_rate > 0.0
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                           memory_space=pltpu.VMEM)
@@ -222,20 +399,25 @@ def _fwd_pallas(q3, k3, v3, bias3, *, scale, causal, block_q, block_k):
     in_specs = [q_spec, kv_spec, kv_spec]
     args = [q3, k3, v3]
     if has_bias:
-        in_specs.append(pl.BlockSpec((1, block_q, block_k),
-                                     lambda b, i, j: (b, i, j),
-                                     memory_space=pltpu.VMEM))
-        args.append(bias3)
+        in_specs.append(_bias_spec(bias4, h, block_q, block_k, swapped=False))
+        args.append(bias4)
+    if has_drop:
+        in_specs.append(_seed_spec())
+        args.append(seed)
 
     def kernel(*refs):
-        if has_bias:
-            q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, acc, m, l = refs
-        else:
-            q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l = refs
-            bias_ref = None
-        _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, acc, m, l,
-                    scale=scale, causal=causal, block_q=block_q,
-                    block_k=block_k, n_kv=n_kv, offset=sk - sq)
+        refs = list(refs)
+        q_ref, k_ref, v_ref = refs[:3]
+        nxt = 3
+        bias_ref = refs[nxt] if has_bias else None
+        nxt += has_bias
+        seed_ref = refs[nxt] if has_drop else None
+        nxt += has_drop
+        o_ref, lse_ref, acc, m, l = refs[nxt:]
+        _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
+                    acc, m, l, scale=scale, causal=causal, block_q=block_q,
+                    block_k=block_k, n_kv=n_kv, offset=sk - sq,
+                    dropout_rate=dropout_rate)
 
     out, lse = pl.pallas_call(
         kernel,
@@ -254,12 +436,13 @@ def _fwd_pallas(q3, k3, v3, bias3, *, scale, causal, block_q, block_k):
     return out, lse
 
 
-def _bwd_pallas(q3, k3, v3, bias3, do3, lse, delta, *, scale, causal,
-                block_q, block_k):
+def _bwd_pallas(q3, k3, v3, bias4, seed, h, do3, lse, delta, *, scale, causal,
+                block_q, block_k, dropout_rate):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     n_q, n_kv = sq // block_q, sk // block_k
-    has_bias = bias3 is not None
+    has_bias = bias4 is not None
+    has_drop = dropout_rate > 0.0
 
     # --- dq: grid (bh, n_q, n_kv), kv innermost ---
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
@@ -271,24 +454,27 @@ def _bwd_pallas(q3, k3, v3, bias3, do3, lse, delta, *, scale, causal,
     in_specs = [q_spec, kv_spec, kv_spec]
     args = [q3, k3, v3]
     if has_bias:
-        in_specs.append(pl.BlockSpec((1, block_q, block_k),
-                                     lambda b, i, j: (b, i, j),
-                                     memory_space=pltpu.VMEM))
-        args.append(bias3)
+        in_specs.append(_bias_spec(bias4, h, block_q, block_k, swapped=False))
+        args.append(bias4)
+    if has_drop:
+        in_specs.append(_seed_spec())
+        args.append(seed)
     in_specs += [q_spec, row_spec, row_spec]
     args += [do3, lse, delta]
 
     def dq_kernel(*refs):
-        if has_bias:
-            (q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
-             dq_ref, dq_acc) = refs
-        else:
-            (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-             dq_ref, dq_acc) = refs
-            bias_ref = None
-        _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
-                       delta_ref, dq_ref, dq_acc, scale=scale, causal=causal,
-                       block_q=block_q, block_k=block_k, n_kv=n_kv, offset=sk - sq)
+        refs = list(refs)
+        q_ref, k_ref, v_ref = refs[:3]
+        nxt = 3
+        bias_ref = refs[nxt] if has_bias else None
+        nxt += has_bias
+        seed_ref = refs[nxt] if has_drop else None
+        nxt += has_drop
+        do_ref, lse_ref, delta_ref, dq_ref, dq_acc = refs[nxt:]
+        _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
+                       lse_ref, delta_ref, dq_ref, dq_acc, scale=scale,
+                       causal=causal, block_q=block_q, block_k=block_k,
+                       n_kv=n_kv, offset=sk - sq, dropout_rate=dropout_rate)
 
     dq = pl.pallas_call(
         dq_kernel,
@@ -310,25 +496,29 @@ def _bwd_pallas(q3, k3, v3, bias3, do3, lse, delta, *, scale, causal,
     in_specs2 = [q_spec2, kv_spec2, kv_spec2]
     args2 = [q3, k3, v3]
     if has_bias:
-        in_specs2.append(pl.BlockSpec((1, block_q, block_k),
-                                      lambda b, j, i: (b, i, j),
-                                      memory_space=pltpu.VMEM))
-        args2.append(bias3)
+        in_specs2.append(_bias_spec(bias4, h, block_q, block_k, swapped=True))
+        args2.append(bias4)
+    if has_drop:
+        in_specs2.append(_seed_spec())
+        args2.append(seed)
     in_specs2 += [q_spec2, row_spec2, row_spec2]
     args2 += [do3, lse, delta]
 
     def dkv_kernel(*refs):
-        if has_bias:
-            (q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
-             dk_ref, dv_ref, dk_acc, dv_acc) = refs
-        else:
-            (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-             dk_ref, dv_ref, dk_acc, dv_acc) = refs
-            bias_ref = None
-        _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
-                        delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+        refs = list(refs)
+        q_ref, k_ref, v_ref = refs[:3]
+        nxt = 3
+        bias_ref = refs[nxt] if has_bias else None
+        nxt += has_bias
+        seed_ref = refs[nxt] if has_drop else None
+        nxt += has_drop
+        (do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc,
+         dv_acc) = refs[nxt:]
+        _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
+                        lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
                         scale=scale, causal=causal, block_q=block_q,
-                        block_k=block_k, n_q=n_q, offset=sk - sq)
+                        block_k=block_k, n_q=n_q, offset=sk - sq,
+                        dropout_rate=dropout_rate)
 
     dk, dv = pl.pallas_call(
         dkv_kernel,
@@ -344,31 +534,134 @@ def _bwd_pallas(q3, k3, v3, bias3, do3, lse, delta, *, scale, causal,
     return dq, dk, dv
 
 
+def _dbias_pallas(q3, k3, v3, bias4, seed, h, do3, lse, delta, *, scale,
+                  causal, block_q, block_k, dropout_rate):
+    """dbias via the accumulating kernel; HBM cost is O(|bias|)."""
+    has_drop = dropout_rate > 0.0
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    n_q, n_kv = sq // block_q, sk // block_k
+    bb, hb, sqb, _ = bias4.shape
+    HB = bb * hb          # kept bh slices (one dbias tile-plane each)
+    R = bh // HB          # bh slices reduced into each kept slice
+    swap = sqb == 1       # bias broadcast over sq: reduce q-blocks too
+    bq = block_q if not swap else 1
+
+    def bh_of(g, r):
+        if bb > 1 and hb > 1:
+            return g
+        if hb > 1:          # broadcast over batch: r enumerates b
+            return r * hb + g
+        if bb > 1:          # broadcast over heads: r enumerates h
+            return g * h + r
+        return r            # broadcast over both
+
+    def kept(g):
+        if bb > 1 and hb > 1:
+            return (g // hb, g % hb)
+        if hb > 1:
+            return (0, g)
+        if bb > 1:
+            return (g, 0)
+        return (0, 0)
+
+    def ij(a, b_):
+        return (b_, a) if swap else (a, b_)
+
+    def q_map(g, a, b_, r):
+        return (bh_of(g, r), ij(a, b_)[0], 0)
+
+    def kv_map(g, a, b_, r):
+        return (bh_of(g, r), ij(a, b_)[1], 0)
+
+    def row_map(g, a, b_, r):
+        return (bh_of(g, r), ij(a, b_)[0], 0)
+
+    def bias_map(g, a, b_, r):
+        bhv = bh_of(g, r)
+        i, j = ij(a, b_)
+        return (bhv // h if bb > 1 else 0, bhv % h if hb > 1 else 0,
+                i if sqb > 1 else 0, j)
+
+    def db_map(g, a, b_, r):
+        i, j = ij(a, b_)
+        return (*kept(g), i if sqb > 1 else 0, j)
+
+    grid = (HB, n_kv, n_q, R) if swap else (HB, n_q, n_kv, R)
+    q_spec = pl.BlockSpec((1, block_q, d), q_map, memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, block_k, d), kv_map, memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, block_q, 1), row_map, memory_space=pltpu.VMEM)
+    bias_spec = pl.BlockSpec((1, 1, bq, block_k), bias_map,
+                             memory_space=pltpu.VMEM)
+    db_spec = pl.BlockSpec((1, 1, bq, block_k), db_map,
+                           memory_space=pltpu.VMEM)
+
+    in_specs = [q_spec, kv_spec, kv_spec, bias_spec]
+    args = [q3, k3, v3, bias4]
+    if has_drop:
+        in_specs.append(_seed_spec())
+        args.append(seed)
+    in_specs += [q_spec, row_spec, row_spec]
+    args += [do3, lse, delta]
+
+    def kernel(*refs):
+        refs = list(refs)
+        q_ref, k_ref, v_ref, bias_ref = refs[:4]
+        nxt = 4
+        seed_ref = refs[nxt] if has_drop else None
+        nxt += has_drop
+        do_ref, lse_ref, delta_ref, db_ref = refs[nxt:]
+        _dbias_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
+                      lse_ref, delta_ref, db_ref, scale=scale, causal=causal,
+                      block_q=block_q, block_k=block_k, swap=swap,
+                      offset=sk - sq, dropout_rate=dropout_rate,
+                      bh_fn=bh_of)
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=db_spec,
+        out_shape=jax.ShapeDtypeStruct(bias4.shape, jnp.float32),
+        interpret=_interp(),
+    )(*args)
+
+
 @functools.lru_cache(maxsize=None)
 def _make_flash(scale: float, causal: bool, block_q: int, block_k: int,
-                has_bias: bool):
+                has_bias: bool, need_dbias: bool, h: int,
+                dropout_rate: float):
     @jax.custom_vjp
-    def flash(q3, k3, v3, bias3):
-        out, _ = _fwd_pallas(q3, k3, v3, bias3 if has_bias else None,
-                             scale=scale, causal=causal,
-                             block_q=block_q, block_k=block_k)
+    def flash(q3, k3, v3, bias4, seed):
+        out, _ = _fwd_pallas(q3, k3, v3, bias4 if has_bias else None, seed,
+                             h, scale=scale, causal=causal, block_q=block_q,
+                             block_k=block_k, dropout_rate=dropout_rate)
         return out
 
-    def fwd(q3, k3, v3, bias3):
-        out, lse = _fwd_pallas(q3, k3, v3, bias3 if has_bias else None,
-                               scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
-        return out, (q3, k3, v3, bias3, out, lse)
+    def fwd(q3, k3, v3, bias4, seed):
+        out, lse = _fwd_pallas(q3, k3, v3, bias4 if has_bias else None, seed,
+                               h, scale=scale, causal=causal, block_q=block_q,
+                               block_k=block_k, dropout_rate=dropout_rate)
+        return out, (q3, k3, v3, bias4, seed, out, lse)
 
     def bwd(res, do3):
-        q3, k3, v3, bias3, out, lse = res
+        q3, k3, v3, bias4, seed, out, lse = res
         delta = jnp.sum(do3.astype(jnp.float32) * out.astype(jnp.float32),
                         axis=-1, keepdims=True)
-        dq, dk, dv = _bwd_pallas(q3, k3, v3, bias3 if has_bias else None,
-                                 do3, lse, delta, scale=scale, causal=causal,
-                                 block_q=block_q, block_k=block_k)
-        dbias = jnp.zeros_like(bias3) if has_bias else None
-        return dq, dk, dv, dbias
+        dq, dk, dv = _bwd_pallas(
+            q3, k3, v3, bias4 if has_bias else None, seed, h, do3, lse,
+            delta, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, dropout_rate=dropout_rate)
+        if has_bias and need_dbias:
+            dbias = _dbias_pallas(q3, k3, v3, bias4, seed, h, do3, lse,
+                                  delta, scale=scale, causal=causal,
+                                  block_q=block_q, block_k=block_k,
+                                  dropout_rate=dropout_rate)
+        else:
+            # documented: zero unless opted in (scalar placeholder when
+            # there is no bias at all)
+            dbias = jnp.zeros_like(bias4)
+        return dq, dk, dv, dbias, jnp.zeros_like(seed)
 
     flash.defvjp(fwd, bwd)
     return flash
@@ -377,32 +670,75 @@ def _make_flash(scale: float, causal: bool, block_q: int, block_k: int,
 def flash_attention(q, k, v, bias=None, causal: bool = False,
                     softmax_scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,
-                    use_pallas: Optional[bool] = None):
+                    use_pallas: Optional[bool] = None,
+                    bias_requires_grad: bool = False,
+                    dropout_rate: float = 0.0,
+                    dropout_seed=None):
     """Fused attention over ``(b, h, s, d)`` tensors.
 
     ``bias``: additive fp32 score bias broadcastable to ``(b, h, sq, sk)``
     (use ``-10000``-filled masks for padding, as the reference softmax does).
-    Falls back to the XLA reference when shapes aren't tile-aligned.
+    Broadcast dims stay broadcast — a padding mask costs O(b·sk) memory.
+
+    ``bias_requires_grad``: the Pallas path returns **zero** gradient for
+    ``bias`` unless this is True (see module docstring). Set it when the
+    bias is a learned parameter (ALiBi/relative-position); leave False for
+    padding masks to keep the backward O(s·d)-memory.
+
+    ``dropout_rate``/``dropout_seed``: in-kernel attention-probability
+    dropout (``philox.cuh`` analog; see module docstring). ``dropout_seed``
+    is an int scalar (vary it per step/layer, e.g. from
+    :func:`~apex_tpu.transformer.tensor_parallel.random.get_rng_tracker`);
+    required when ``dropout_rate > 0``.
+
+    Falls back to the XLA reference when shapes aren't tile-aligned (same
+    dropout mask and same zero-bias-grad semantics on both paths).
     """
     b, h, sq, d = q.shape
     sk = k.shape[2]
     if softmax_scale is None:
         softmax_scale = 1.0 / math.sqrt(d)
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed")
     if use_pallas is None:
         use_pallas = supports_flash(sq, sk, d, block_q, block_k)
     if not use_pallas:
-        return mha_reference(q, k, v, bias, causal, softmax_scale)
+        # honor bias_requires_grad here too so gradient semantics do not
+        # silently flip with tile alignment
+        if bias is not None and not bias_requires_grad:
+            bias = jax.lax.stop_gradient(bias)
+        return mha_reference(q, k, v, bias, causal, softmax_scale,
+                             dropout_rate=dropout_rate,
+                             dropout_seed=dropout_seed)
 
     q3 = q.reshape(b * h, sq, d)
     k3 = k.reshape(b * h, sk, d)
     v3 = v.reshape(b * h, sk, d)
     has_bias = bias is not None
     if has_bias:
-        bias3 = jnp.broadcast_to(bias.astype(jnp.float32),
-                                 (b, h, sq, sk)).reshape(b * h, sq, sk)
+        bias4 = jnp.asarray(bias, jnp.float32)
+        if bias4.ndim > 4:
+            raise ValueError(f"bias rank {bias4.ndim} > 4")
+        while bias4.ndim < 4:
+            bias4 = bias4[None]
+        for ax, (dim, full) in enumerate(zip(bias4.shape, (b, h, sq, sk))):
+            if dim not in (1, full):
+                raise ValueError(
+                    f"bias dim {ax} is {dim}; must be 1 or {full}")
+        if bias4.shape[3] == 1 and sk > 1:
+            # keys dim must be materialized for the (…, block_k) tiles
+            bias4 = jnp.broadcast_to(bias4, (*bias4.shape[:3], sk))
     else:
-        bias3 = jnp.zeros((), jnp.float32)  # placeholder pytree leaf
+        bias4 = jnp.zeros((), jnp.float32)  # placeholder pytree leaf
+    if dropout_rate > 0.0:
+        # fp32 seed scalar (SMEM-friendly, and a differentiable placeholder
+        # for custom_vjp); 24-bit space composed with per-element counters
+        seed = jnp.reshape(
+            jnp.asarray(dropout_seed) % (1 << 24), (1,)).astype(jnp.float32)
+    else:
+        seed = jnp.zeros((1,), jnp.float32)
     fn = _make_flash(float(softmax_scale), bool(causal), block_q, block_k,
-                     has_bias)
-    out = fn(q3, k3, v3, bias3)
+                     has_bias, bool(bias_requires_grad), h,
+                     float(dropout_rate))
+    out = fn(q3, k3, v3, bias4, seed)
     return out.reshape(b, h, sq, d)

@@ -1,0 +1,293 @@
+"""ZeRO-style sharded-optimizer data parallelism.
+
+Reference: ``reference:apex/contrib/optimizers/distributed_fused_adam.py``
+(flat grad buffer, ``reduce_scatter`` of grads :409, optimizer state sharded
+across the DP group :202-207, ``all_gather`` of updated params :477, comm
+overlapped with bprop via grad hooks :162) and ``distributed_fused_lamb.py``
+(same scheme + global grad-norm clip + per-tensor trust ratios).
+
+TPU redesign: the whole scheme collapses to three collectives inside
+``shard_map`` over the ``data`` mesh axis:
+
+1. grads (replicated layout, one pytree per device) are raveled into one
+   flat fp32 vector and ``psum_scatter``'d — each device receives the
+   *summed* 1/dp shard it owns, the exact ``reduce_scatter`` of :409;
+2. optimizer math (Adam/LAMB, fp32 master params + moments) runs on the
+   flat shard only — per-device optimizer state is 1/dp of the dense
+   version, the ZeRO memory win;
+3. the updated master shard is ``all_gather``'d (:477) and unraveled back
+   to the parameter pytree in the parameter dtype.
+
+The reference's manual comm/compute overlap (grad hooks kicking off
+reduce-scatters mid-backward, stream pools) is XLA's job here: with the
+train step jitted end to end, the latency-hiding scheduler overlaps the
+psum_scatter with the tail of the backward. Donate the optimizer state to
+avoid the post-backward copy wall.
+
+Per-tensor quantities (LAMB trust ratios) survive the flat layout via a
+static segment-id map from flat index to tensor index (``segment_sum`` on
+the shard + ``psum`` = exact per-tensor norms, the role of
+``multi_tensor_l2norm`` in ``distributed_fused_lamb.py:435-470``).
+
+``init`` must run inside ``shard_map`` (it slices this rank's shard with
+``axis_index``); the natural place is the first jitted train step or an
+explicit jitted init step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.optimizers._base import OptimizerBase, bias_correction
+
+__all__ = ["DistributedFusedAdam", "DistributedFusedLAMB",
+           "ZeroAdamState", "ZeroLambState"]
+
+
+class _FlatLayout(NamedTuple):
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[Any, ...]
+    sizes: Tuple[int, ...]
+    offsets: Tuple[int, ...]
+    total: int
+    padded: int
+    shard: int            # padded // dp
+    dp: int
+
+
+class ZeroAdamState(NamedTuple):
+    step: jnp.ndarray     # i32 scalar
+    master: jnp.ndarray   # fp32 flat shard of master params
+    exp_avg: jnp.ndarray  # fp32 flat shard
+    exp_avg_sq: jnp.ndarray
+
+
+# identical layout; one definition so shard-spec plumbing is shared
+ZeroLambState = ZeroAdamState
+
+
+class _DistributedFusedBase(OptimizerBase):
+    def __init__(self, axis_name: str = "data"):
+        self.axis_name = axis_name
+        self._layout: Optional[_FlatLayout] = None
+
+    # -- flat layout ------------------------------------------------------
+    def _build_layout(self, params: Any) -> _FlatLayout:
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        shapes = tuple(tuple(np.shape(l)) for l in leaves)
+        dtypes = tuple(jnp.asarray(l).dtype for l in leaves)
+        sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
+        offsets = tuple(int(x) for x in np.cumsum((0,) + sizes[:-1]))
+        total = int(sum(sizes))
+        dp = jax.lax.axis_size(self.axis_name)
+        padded = ((total + dp - 1) // dp) * dp
+        return _FlatLayout(treedef, shapes, dtypes, sizes, offsets, total,
+                           padded, padded // dp, dp)
+
+    def _layout_for(self, params: Any) -> _FlatLayout:
+        lay = self._build_layout(params)
+        if self._layout is not None and (
+                self._layout.shapes != lay.shapes
+                or self._layout.dp != lay.dp):
+            raise ValueError("parameter structure changed between calls")
+        self._layout = lay
+        return lay
+
+    def _ravel(self, tree: Any, lay: _FlatLayout) -> jnp.ndarray:
+        leaves = lay.treedef.flatten_up_to(tree)
+        flat = jnp.concatenate(
+            [jnp.reshape(jnp.asarray(l), (-1,)).astype(jnp.float32)
+             for l in leaves])
+        if lay.padded != lay.total:
+            flat = jnp.pad(flat, (0, lay.padded - lay.total))
+        return flat
+
+    def _unravel(self, flat: jnp.ndarray, lay: _FlatLayout) -> Any:
+        leaves = []
+        for shape, dtype, size, off in zip(lay.shapes, lay.dtypes,
+                                           lay.sizes, lay.offsets):
+            leaves.append(jax.lax.dynamic_slice_in_dim(flat, off, size)
+                          .reshape(shape).astype(dtype))
+        return jax.tree_util.tree_unflatten(lay.treedef, leaves)
+
+    def _my_slice(self, flat: jnp.ndarray, lay: _FlatLayout) -> jnp.ndarray:
+        rank = jax.lax.axis_index(self.axis_name)
+        return jax.lax.dynamic_slice_in_dim(flat, rank * lay.shard, lay.shard)
+
+    def _segment_ids(self, lay: _FlatLayout) -> jnp.ndarray:
+        """Static flat-index -> tensor-index map (padding gets an extra id
+        so it never contaminates a real tensor's norm)."""
+        ids = np.full(lay.padded, len(lay.sizes), np.int32)
+        for i, (off, size) in enumerate(zip(lay.offsets, lay.sizes)):
+            ids[off:off + size] = i
+        return jnp.asarray(ids)
+
+    def _shard_grads(self, grads: Any, lay: _FlatLayout) -> jnp.ndarray:
+        """reduce_scatter: flat-averaged grads, this rank's shard only."""
+        flat_g = self._ravel(grads, lay)
+        g = jax.lax.psum_scatter(flat_g, self.axis_name, scatter_dimension=0,
+                                 tiled=True)
+        return g / lay.dp
+
+    def _gather_params(self, master: jnp.ndarray, lay: _FlatLayout) -> Any:
+        # all_gather_invariant: the gathered params are replicated by
+        # construction, and typing them device-invariant lets callers keep
+        # P() out_specs for params (a plain all_gather's varying type would
+        # fail shard_map's replication check)
+        try:
+            from jax._src.lax.parallel import all_gather_invariant
+            flat = all_gather_invariant(master, self.axis_name, axis=0,
+                                        tiled=True)
+        except ImportError:  # pragma: no cover - private symbol moved
+            # equivalent invariant-typed gather: place the shard at its
+            # offset in a zero vector and psum (disjoint one-hot sum)
+            rank = jax.lax.axis_index(self.axis_name)
+            flat = jax.lax.psum(
+                jax.lax.dynamic_update_slice_in_dim(
+                    jnp.zeros(lay.padded, master.dtype), master,
+                    rank * lay.shard, axis=0),
+                self.axis_name)
+        return self._unravel(flat, lay)
+
+
+class DistributedFusedAdam(_DistributedFusedBase):
+    """ZeRO sharded Adam/AdamW (``distributed_fused_adam.py:9``).
+
+    Numerics match :class:`apex_tpu.optimizers.FusedAdam` with DDP grad
+    averaging, while per-device optimizer state (fp32 master + m + v) is
+    1/dp of the dense version.
+    """
+
+    def __init__(self, lr: float = 1e-3, bias_correction: bool = True,
+                 betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
+                 adam_w_mode: bool = True, weight_decay: float = 0.0,
+                 axis_name: str = "data"):
+        super().__init__(axis_name)
+        self.lr = lr
+        self.use_bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.adam_w_mode = adam_w_mode
+        self.weight_decay = weight_decay
+
+    def init(self, params: Any) -> ZeroAdamState:
+        lay = self._layout_for(params)
+        master = self._my_slice(self._ravel(params, lay), lay)
+        zeros = jnp.zeros(lay.shard, jnp.float32)
+        return ZeroAdamState(step=jnp.asarray(0, jnp.int32), master=master,
+                             exp_avg=zeros, exp_avg_sq=zeros)
+
+    def _step(self, grads: Any, state: ZeroAdamState, params: Any,
+              lr: Optional[Any] = None,
+              weight_decay: Optional[Any] = None
+              ) -> Tuple[Any, ZeroAdamState]:
+        lay = self._layout_for(params)
+        lr = jnp.asarray(self.lr if lr is None else lr, jnp.float32)
+        wd = jnp.asarray(
+            self.weight_decay if weight_decay is None else weight_decay,
+            jnp.float32)
+        t = state.step + 1
+        if self.use_bias_correction:
+            bc1 = bias_correction(self.beta1, t)
+            bc2 = bias_correction(self.beta2, t)
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+        b1, b2 = self.beta1, self.beta2
+
+        g = self._shard_grads(grads, lay)
+        p32 = state.master
+        if not self.adam_w_mode:
+            g = g + wd * p32
+        m = b1 * state.exp_avg + (1.0 - b1) * g
+        v = b2 * state.exp_avg_sq + (1.0 - b2) * g * g
+        update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+        if self.adam_w_mode:
+            update = update + wd * p32
+        new_master = p32 - lr * update
+        new_params = self._gather_params(new_master, lay)
+        return new_params, ZeroAdamState(step=t, master=new_master,
+                                         exp_avg=m, exp_avg_sq=v)
+
+
+class DistributedFusedLAMB(_DistributedFusedBase):
+    """ZeRO sharded LAMB (``distributed_fused_lamb.py:10``): global grad-norm
+    clip, then per-tensor trust ratios — per-tensor norms come from
+    ``segment_sum`` on the flat shard + ``psum`` (exact, not approximated).
+    """
+
+    def __init__(self, lr: float = 1e-3, bias_correction: bool = True,
+                 betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-6,
+                 weight_decay: float = 0.01, max_grad_norm: float = 1.0,
+                 use_nvlamb: bool = False, axis_name: str = "data"):
+        super().__init__(axis_name)
+        self.lr = lr
+        self.use_bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.max_grad_norm = max_grad_norm
+        self.use_nvlamb = use_nvlamb
+
+    def init(self, params: Any) -> ZeroLambState:
+        lay = self._layout_for(params)
+        master = self._my_slice(self._ravel(params, lay), lay)
+        zeros = jnp.zeros(lay.shard, jnp.float32)
+        return ZeroLambState(step=jnp.asarray(0, jnp.int32), master=master,
+                             exp_avg=zeros, exp_avg_sq=zeros)
+
+    def _per_tensor(self, vec_sq: jnp.ndarray, seg: jnp.ndarray,
+                    lay: _FlatLayout) -> jnp.ndarray:
+        """psum of shard-local segment sums -> per-tensor sums (n_tensors+1,
+        last slot is padding)."""
+        part = jax.ops.segment_sum(vec_sq, seg, num_segments=len(lay.sizes) + 1)
+        return jax.lax.psum(part, self.axis_name)
+
+    def _step(self, grads: Any, state: ZeroLambState, params: Any,
+              lr: Optional[Any] = None,
+              weight_decay: Optional[Any] = None
+              ) -> Tuple[Any, ZeroLambState]:
+        lay = self._layout_for(params)
+        lr = jnp.asarray(self.lr if lr is None else lr, jnp.float32)
+        wd = jnp.asarray(
+            self.weight_decay if weight_decay is None else weight_decay,
+            jnp.float32)
+        t = state.step + 1
+        if self.use_bias_correction:
+            bc1 = bias_correction(self.beta1, t)
+            bc2 = bias_correction(self.beta2, t)
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+        b1, b2 = self.beta1, self.beta2
+        seg = self._my_slice(self._segment_ids(lay), lay)
+
+        g = self._shard_grads(grads, lay)
+        # phase 1: global grad-norm clip (reference fused_lamb.py:124-152)
+        gnorm_sq = jax.lax.psum(jnp.sum(g * g), self.axis_name)
+        gnorm = jnp.sqrt(gnorm_sq)
+        clip = jnp.where(
+            (self.max_grad_norm > 0) & (gnorm > self.max_grad_norm),
+            gnorm / self.max_grad_norm, 1.0)
+        g = g / clip
+
+        p32 = state.master
+        m = b1 * state.exp_avg + (1.0 - b1) * g
+        v = b2 * state.exp_avg_sq + (1.0 - b2) * g * g
+        update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps) + wd * p32
+
+        # phase 2: per-tensor trust ratios
+        p_norm = jnp.sqrt(self._per_tensor(p32 * p32, seg, lay))
+        u_norm = jnp.sqrt(self._per_tensor(update * update, seg, lay))
+        if self.use_nvlamb:
+            ratio = jnp.where(u_norm > 0, p_norm / u_norm, 1.0)
+        else:
+            ratio = jnp.where((p_norm > 0) & (u_norm > 0),
+                              p_norm / u_norm, 1.0)
+        new_master = p32 - lr * jnp.take(ratio, seg) * update
+        new_params = self._gather_params(new_master, lay)
+        return new_params, ZeroLambState(step=t, master=new_master,
+                                         exp_avg=m, exp_avg_sq=v)

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu.utils.vma import cast_to_vma
+
 __all__ = ["allreduce_grads", "DistributedDataParallel", "Reducer",
            "grouped_psum"]
 
@@ -134,7 +136,7 @@ class DistributedDataParallel:
         the "wrap your model and backward just works" usage shape of apex DDP.
 
         The first argument (params) is marked device-varying
-        (``lax.pvary``) before differentiation: each device differentiates
+        (``lax.pcast(..., to='varying')``) before differentiation: each device differentiates
         its own replica and the sync is this class's explicit allreduce —
         exactly torch-DDP's model. (Without this, shard_map's AD would
         auto-``psum`` cotangents of replicated params and an explicit sync
@@ -142,7 +144,7 @@ class DistributedDataParallel:
         """
         def wrapped(params, *args, **kwargs):
             params = jax.tree_util.tree_map(
-                lambda p: jax.lax.pvary(p, self.axis_name), params)
+                lambda p: cast_to_vma(p, frozenset({self.axis_name})), params)
             value, grads = jax.value_and_grad(loss_fn, **vag_kwargs)(
                 params, *args, **kwargs)
             return value, self.sync_gradients(grads)

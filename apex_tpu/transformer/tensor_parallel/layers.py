@@ -67,6 +67,23 @@ def _dense(x, w_t):
                                preferred_element_type=jnp.float32)
 
 
+@jax.custom_vjp
+def _scale_grad(x: jnp.ndarray, scale: float) -> jnp.ndarray:
+    """Identity forward; multiplies the cotangent by ``scale`` backward."""
+    return x
+
+
+def _scale_grad_fwd(x, scale):
+    return x, scale
+
+
+def _scale_grad_bwd(scale, g):
+    return (g * scale, None)
+
+
+_scale_grad.defvjp(_scale_grad_fwd, _scale_grad_bwd)
+
+
 def _local_shard(stacked: jnp.ndarray, world_size: int) -> jnp.ndarray:
     """Resolve this rank's shard of a ``(tp, ...)``-stacked param.
 
@@ -188,12 +205,16 @@ class RowParallelLinear:
         b = _local_shard(params["bias"], self.world_size) if self.use_bias \
             else None
         if b is not None and not self.skip_bias_add:
-            # fold b/tp into the pre-psum partial: same forward value, and
-            # the psum transpose hands every rank the same (psum(g)/tp) bias
-            # grad — a rank-local post-reduce add would give each bias copy
-            # a different, 1/tp-scale cotangent and the replicas would drift
-            partial = partial + (b.astype(jnp.float32)
-                                 / self.world_size).astype(partial.dtype)
+            # Forward folds b/tp into the pre-psum partial so the psum adds
+            # the bias exactly once without up-casting its (replicated)
+            # output back to varying — an actual post-reduce add would make
+            # AD psum the whole cotangent, inflating the *weight* grads by
+            # tp. The naked fold would hand each bias copy cotangent g/tp
+            # (starving norm-sensitive optimizers like LARC/SGD vs a TP=1
+            # run), so _scale_grad restores the reference semantics
+            # (:649-657, bias added after reduce → full grad per copy).
+            b_fold = _scale_grad(b.astype(jnp.float32), self.world_size)
+            partial = partial + (b_fold / self.world_size).astype(partial.dtype)
             b = None
         out = (reduce_from_tensor_model_parallel_region(partial)
                if self.world_size > 1 else partial)

@@ -84,18 +84,23 @@ def get_rng_tracker() -> RNGStatesTracker:
     return _GLOBAL_TRACKER
 
 
-def model_parallel_seed(seed: int, tensor_rank: Optional[int] = None
-                        ) -> None:
+def model_parallel_seed(seed: int, tensor_rank: Optional[int] = None,
+                        data_rank: Optional[int] = None) -> None:
     """``model_parallel_cuda_manual_seed`` (:200-230): installs the default
     (data-parallel) stream at ``seed`` and the model-parallel stream at
     ``seed + 2718 + tp_rank``.
 
-    ``tensor_rank`` may be a traced rank (inside shard_map) — keys are built
-    with ``fold_in`` so tracing works.
+    ``tensor_rank``/``data_rank`` may be traced ranks (inside shard_map) —
+    keys are built with ``fold_in`` so tracing works. ``data_rank`` is an
+    extension over the reference: folding it into the default stream gives
+    each DP replica independent dropout masks (the reference reuses ``seed``
+    on every rank).
     """
     tracker = get_rng_tracker()
     tracker.reset()
     base = jax.random.PRNGKey(seed)
+    if data_rank is not None:
+        base = jax.random.fold_in(base, data_rank)
     tracker.add("default", base)
     if tensor_rank is None:
         tp_key = jax.random.PRNGKey(seed + _TENSOR_SEED_OFFSET)

@@ -97,6 +97,152 @@ def test_bf16_path():
                                np.asarray(ref, np.float32), rtol=2e-2, atol=2e-2)
 
 
+def test_bwd_fully_masked_rows_block_misaligned():
+    """ADVICE r1 (medium): causal with sk<sq leaves rows 0..(sq-sk-1) fully
+    masked; when block_q straddles the masked-row boundary (block_q=24 does
+    not divide 128) the backward used to produce exp(-1e30 - -1e30) = 1
+    garbage p on those rows, contaminating dk/dv (~7.5 abs divergence)."""
+    q, k, v = _qkv(b=1, h=1, sq=240, sk=128, seed=11)
+    assert supports_flash(240, 128, 64, 24, 128)
+    n_masked = 240 - 128  # rows with no visible keys
+    dy = np.random.RandomState(12).randn(1, 1, 240, 64)
+    dy[:, :, :n_masked] = 0.0  # fully-masked rows are undefined: exclude
+    dy = jnp.asarray(dy, jnp.float32)
+
+    def f(q, k, v, use_pallas):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       block_q=24, block_k=128,
+                                       use_pallas=use_pallas) * dy)
+
+    g_flash = jax.grad(lambda a, b, c: f(a, b, c, True),
+                       argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(lambda a, b, c: f(a, b, c, False),
+                     argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+    # and the flash fwd output on fully-masked rows is exactly zero
+    out = flash_attention(q, k, v, causal=True, block_q=24, block_k=128,
+                          use_pallas=True)
+    assert np.all(np.asarray(out)[:, :, :n_masked] == 0.0)
+
+
+@pytest.mark.parametrize("bias_shape", [
+    (1, 2, 128, 128),   # shared over batch (rel-pos table)
+    (2, 2, 128, 128),   # full (no reduction)
+    (1, 1, 128, 128),   # shared over batch and heads
+    (2, 1, 128, 128),   # shared over heads
+    (1, 2, 1, 128),     # broadcast over sq too (ALiBi-style row)
+])
+def test_dbias_learned_bias(bias_shape):
+    """bias_requires_grad=True returns the real dbias (score cotangent summed
+    over broadcast dims), matching the XLA fallback's bias grad."""
+    q, k, v = _qkv(b=2, h=2, sq=128, sk=128, seed=13)
+    bias = jnp.asarray(np.random.RandomState(14).randn(*bias_shape) * 0.1,
+                       jnp.float32)
+    dy = jnp.asarray(np.random.RandomState(15).randn(*q.shape), jnp.float32)
+
+    def f(bias, use_pallas):
+        return jnp.sum(flash_attention(
+            q, k, v, bias=bias, causal=True, use_pallas=use_pallas,
+            bias_requires_grad=True) * dy)
+
+    db_flash = jax.grad(lambda b: f(b, True))(bias)
+    db_ref = jax.grad(lambda b: f(b, False))(bias)
+    np.testing.assert_allclose(np.asarray(db_flash), np.asarray(db_ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_dbias_zero_by_default_both_paths():
+    """Without bias_requires_grad the bias grad is zero on the Pallas path
+    AND the XLA fallback (semantics must not flip with tile alignment)."""
+    q, k, v = _qkv(b=2, h=2, sq=128, sk=128, seed=13)
+    bias = jnp.asarray(np.random.RandomState(14).randn(1, 2, 128, 128) * 0.1,
+                       jnp.float32)
+    dy = jnp.asarray(np.random.RandomState(15).randn(*q.shape), jnp.float32)
+    for use_pallas in (True, False):
+        db = jax.grad(lambda b: jnp.sum(flash_attention(
+            q, k, v, bias=b, use_pallas=use_pallas) * dy))(bias)
+        assert np.all(np.asarray(db) == 0.0)
+
+
+def test_padding_mask_broadcast_shapes():
+    """Padding-style biases keep their broadcast shape ((b,1,1,sk) costs
+    O(b·sk) HBM, ADVICE r1) and still match the reference."""
+    q, k, v = _qkv(b=2, h=2, sq=128, sk=256, seed=16)
+    rng = np.random.RandomState(17)
+    for shape in [(2, 1, 1, 256), (1, 1, 128, 256), (1, 2, 128, 256),
+                  (2, 2, 1, 256)]:
+        b_ = jnp.where(jnp.asarray(rng.rand(*shape) > 0.2),
+                       0.0, -10000.0).astype(jnp.float32)
+        out = flash_attention(q, k, v, bias=b_, use_pallas=True)
+        ref = mha_reference(q, k, v, bias=b_)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_dropout_matches_reference_mask():
+    """In-kernel dropout (philox analog) agrees with the XLA reference using
+    the same counter-derived mask — forward AND all gradients."""
+    q, k, v = _qkv(b=2, h=2, sq=256, sk=256, seed=20)
+    dy = jnp.asarray(np.random.RandomState(21).randn(*q.shape), jnp.float32)
+    seed = jnp.asarray(12345, jnp.int32)
+
+    def f(q, k, v, use_pallas):
+        return jnp.sum(flash_attention(
+            q, k, v, causal=True, dropout_rate=0.3, dropout_seed=seed,
+            use_pallas=use_pallas) * dy)
+
+    out_fl = flash_attention(q, k, v, causal=True, dropout_rate=0.3,
+                             dropout_seed=seed, use_pallas=True)
+    out_ref = flash_attention(q, k, v, causal=True, dropout_rate=0.3,
+                              dropout_seed=seed, use_pallas=False)
+    np.testing.assert_allclose(np.asarray(out_fl), np.asarray(out_ref),
+                               rtol=2e-5, atol=2e-5)
+
+    g_fl = jax.grad(lambda a, b, c: f(a, b, c, True), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(lambda a, b, c: f(a, b, c, False), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_fl, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_dropout_deterministic_and_seed_dependent():
+    q, k, v = _qkv(b=1, h=2, sq=128, sk=128, seed=22)
+    out1 = flash_attention(q, k, v, dropout_rate=0.5, dropout_seed=7,
+                           use_pallas=True)
+    out2 = flash_attention(q, k, v, dropout_rate=0.5, dropout_seed=7,
+                           use_pallas=True)
+    out3 = flash_attention(q, k, v, dropout_rate=0.5, dropout_seed=8,
+                           use_pallas=True)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    assert not np.array_equal(np.asarray(out1), np.asarray(out3))
+    # rate ~ 0.5: dropped entries show up as a large deviation from rate 0
+    base = flash_attention(q, k, v, use_pallas=True)
+    assert not np.allclose(np.asarray(out1), np.asarray(base))
+
+
+def test_dropout_mask_statistics():
+    from apex_tpu.ops.flash_attention import dropout_keep_mask
+    m = np.asarray(dropout_keep_mask(3, 2, 2, 256, 256, 0.3))
+    assert abs(m.mean() - 0.7) < 0.01
+    # rows/cols not degenerate: no all-dropped row at this size
+    assert m.any(axis=-1).all()
+
+
+def test_dropout_requires_seed():
+    q, k, v = _qkv(b=1, h=1, sq=128, sk=128)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, dropout_rate=0.1)
+
+
+def test_bias_bad_shape_raises():
+    q, k, v = _qkv(b=2, h=2, sq=128, sk=128, seed=18)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, bias=jnp.zeros((3, 1, 1, 128)),
+                        use_pallas=True)
+
+
 def test_unaligned_falls_back():
     q, k, v = _qkv(sq=100, sk=100, seed=10)
     assert not supports_flash(100, 100, 64, 128, 128)

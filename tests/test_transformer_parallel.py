@@ -153,6 +153,49 @@ def test_column_row_parallel_linear_match_dense(mesh_tp4):
         assert np.isfinite(np.asarray(leaf)).all()
 
 
+def test_row_parallel_grads_match_dense(mesh_tp4):
+    """TP=4 weight AND bias grads equal the dense (TP=1) grads on every rank
+    (ADVICE r1: the bias copies used to receive grad/tp)."""
+    mesh = parallel_state.get_mesh()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 16), jnp.float32)
+
+    row = tp.RowParallelLinear(16, 8, input_is_parallel=False, world_size=4)
+    params = row.init(jax.random.PRNGKey(0))
+    params = {"weight": params["weight"], "bias": params["bias"] + 0.3}
+
+    def loss_tp(params, x):
+        y, _ = row(params, x)
+        return jnp.sum(y ** 2)
+
+    def run(params, x):
+        def inner(params, x):
+            l, g = jax.value_and_grad(loss_tp)(params, x)
+            return jax.lax.pmean(l, "tensor"), g
+        specs = {"weight": P("tensor"), "bias": P("tensor")}
+        return shard_map(inner, mesh=mesh, in_specs=(specs, P()),
+                         out_specs=(P(), specs))(params, x)
+
+    l, g = jax.jit(run)(params, x)
+
+    w_full = jnp.concatenate([params["weight"][i] for i in range(4)], axis=1)
+    b_full = params["bias"][0]
+
+    def loss_dense(w, b, x):
+        return jnp.sum((x @ w.T + b) ** 2)
+
+    ld, (gw, gb) = jax.value_and_grad(loss_dense, argnums=(0, 1))(
+        w_full, b_full, x)
+    np.testing.assert_allclose(float(l), float(ld), rtol=1e-5)
+    for i in range(4):
+        # every replicated bias copy gets the FULL dense grad, not grad/tp
+        np.testing.assert_allclose(np.asarray(g["bias"][i]), np.asarray(gb),
+                                   rtol=1e-5)
+    gw_tp = jnp.concatenate([g["weight"][i] for i in range(4)], axis=1)
+    np.testing.assert_allclose(np.asarray(gw_tp), np.asarray(gw),
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_vocab_parallel_embedding(mesh_tp4):
     mesh = parallel_state.get_mesh()
     emb = tp.VocabParallelEmbedding(64, 16)
