@@ -311,3 +311,47 @@ class GPTModel:
                 params["layers"])
 
         return stage, split_params
+
+    def pipeline_fns(self, num_stages: int, targets: jnp.ndarray):
+        """Full-model pipeline decomposition — embedding INSIDE the
+        pipeline: stage 0 embeds tokens (pre_process), the last stage
+        applies final LN + tied logits + LM loss (post_process), layer
+        chunks in between (``reference:apex/transformer/pipeline_parallel/
+        schedules/common.py:29-148``). The embedding + final-LN params are
+        pipe-*shared*; the schedules psum their grads over ``pipe``, which
+        realizes the tied-embedding allreduce over the embedding group
+        (``reference:apex/transformer/parallel_state.py:215-247``,
+        ``get_embedding_ranks`` — here the group is carved by grad masking
+        rather than a process-group object).
+
+        ``targets``: ``(M, mb, seq)`` int labels for the per-microbatch loss.
+
+        Returns ``(stage_fn, embed_fn, head_loss_fn, split_params,
+        shared_of)`` matching the ``shared_params``/``embed_fn`` arguments of
+        the pipelined schedules: feed token microbatches ``(M, mb, seq)``
+        directly as ``batch``.
+        """
+        stage, split_params = self.stage_fn(num_stages)
+
+        def shared_of(params: dict) -> dict:
+            return {"embedding": params["embedding"],
+                    "final_ln": params["final_ln"]}
+
+        def embed_fn(shared: dict, tokens: jnp.ndarray) -> jnp.ndarray:
+            return self.embed({"embedding": shared["embedding"]}, tokens)
+
+        def head_loss_fn(shared: dict, y: jnp.ndarray,
+                         m: jnp.ndarray) -> jnp.ndarray:
+            x = self._ln(shared["final_ln"], y)
+            logits = self.logits({"embedding": shared["embedding"]}, x)
+            tgt = jax.lax.dynamic_index_in_dim(targets, m, 0, keepdims=False)
+            if self.cfg.tensor_model_parallel_size > 1:
+                per_tok = vocab_parallel_cross_entropy(logits, tgt)
+            else:
+                per_tok = softmax_cross_entropy_loss(
+                    logits.reshape(-1, logits.shape[-1]), tgt.reshape(-1),
+                    padding_idx=None, half_to_float=True
+                ).reshape(tgt.shape)
+            return jnp.mean(per_tok)
+
+        return stage, embed_fn, head_loss_fn, split_params, shared_of

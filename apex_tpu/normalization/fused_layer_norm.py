@@ -132,7 +132,8 @@ def _run(x, weight, bias, normalized_shape, eps, rms, out_dtype,
         n *= d
     x2d = x.reshape(n, h)
     if use_pallas is None:
-        use_pallas = _pallas.supports_pallas(n, h)
+        use_pallas = _pallas.supports_pallas(n, h) and _pallas.prefer_pallas(
+            n, h)
     core = _make_core(rms, float(eps), jnp.dtype(out_dtype).name,
                       bool(use_pallas), weight is not None, bias is not None)
     w2 = weight.reshape(h) if weight is not None else jnp.zeros((), jnp.float32)

@@ -74,19 +74,38 @@ def _mix32(x):
     return x ^ (x >> 16)
 
 
-def _keep_mask(seed_f, bh, i, j, block_q, block_k, rate):
+def _pack_seed(dropout_seed) -> jnp.ndarray:
+    """Full 32-bit seed as two fp32-exact 16-bit halves ``[hi, lo]`` —
+    fp32 is the SMEM/custom_vjp-friendly carrier but only represents ints to
+    2**24, so the seed rides split (each half < 2**16 is exact)."""
+    s = jnp.asarray(dropout_seed).astype(jnp.int32)
+    hi = jax.lax.shift_right_logical(s, 16) & 0xFFFF
+    lo = s & 0xFFFF
+    return jnp.stack([hi, lo]).astype(jnp.float32).reshape(2)
+
+
+def _unpack_seed(hi_f, lo_f):
+    # f32 -> i32 -> u32: Mosaic has no direct float->unsigned cast
+    hi = hi_f.astype(jnp.int32)
+    lo = lo_f.astype(jnp.int32)
+    return (jax.lax.shift_left(hi, 16) | lo).astype(jnp.uint32)
+
+
+def _keep_mask(seed2, bh, i, j, block_q, block_k, rate):
     """Counter-based dropout keep mask for score block (i, j) of batch-head
-    ``bh`` — the ``philox.cuh`` analog. Depends only on the *global*
+    ``bh`` — the ``philox.cuh`` analog. ``seed2`` is the ``(hi, lo)`` fp32
+    pair from :func:`_pack_seed`. Depends only on the *global*
     (seed, bh, row, col) coordinates, so every kernel (fwd, dq, dkv, dbias)
     and the host-side test reference regenerate the identical mask."""
-    # f32 -> i32 -> u32: Mosaic has no direct float->unsigned cast
-    seed = seed_f.astype(jnp.int32).astype(jnp.uint32)
+    seed = _unpack_seed(seed2[0], seed2[1])
     row = (i * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)).astype(jnp.uint32)
     col = (j * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)).astype(jnp.uint32)
     h = _mix32(seed ^ _mix32(jnp.asarray(bh).astype(jnp.uint32)))
-    x = _mix32(h ^ _mix32(row * _GOLD + col))
+    # two finalizer rounds over the combined counter (single-round murmur
+    # finalizers show detectable structure; a second round is cheap)
+    x = _mix32(_mix32(h ^ _mix32(row * _GOLD + col)) + _GOLD)
     # compare in the integer domain (Mosaic has no unsigned->float cast):
     # keep iff the top-24-bit draw >= rate * 2^24
     thresh = np.int32(int(rate * (1 << 24)))
@@ -97,10 +116,11 @@ def dropout_keep_mask(seed, b, h, sq, sk, rate):
     """Host/XLA version of the in-kernel dropout mask (for parity tests and
     the non-Pallas fallback): (b, h, sq, sk) boolean keep mask identical to
     what the kernels generate for ``seed``."""
-    seed_f = (jnp.asarray(seed) % (1 << 24)).astype(jnp.float32)
+    seed2 = _pack_seed(seed)
     bh_ids = jnp.arange(b * h, dtype=jnp.int32)
     masks = jax.vmap(
-        lambda bh: _keep_mask(seed_f, bh, 0, 0, sq, sk, rate))(bh_ids)
+        lambda bh: _keep_mask((seed2[0], seed2[1]), bh, 0, 0, sq, sk, rate))(
+            bh_ids)
     return masks.reshape(b, h, sq, sk)
 
 
@@ -185,7 +205,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
         l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=1, keepdims=True)
         m_ref[:] = m_new
         if dropout_rate > 0.0:
-            keep = _keep_mask(seed_ref[0], bh, i, j, block_q, block_k,
+            keep = _keep_mask((seed_ref[0], seed_ref[1]), bh, i, j,
+                              block_q, block_k,
                               dropout_rate)
             p = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_rate))
         pv = jax.lax.dot_general(p.astype(v_ref.dtype), v_ref[0],
@@ -238,7 +259,8 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, lse_ref,
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
     if dropout_rate > 0.0:
-        keep = _keep_mask(seed_ref[0], bh, i, j, block_q, block_k,
+        keep = _keep_mask((seed_ref[0], seed_ref[1]), bh, i, j,
+                          block_q, block_k,
                           dropout_rate)
         inv = 1.0 / (1.0 - dropout_rate)
         p_eff = jnp.where(keep, p, 0.0) * inv
@@ -380,7 +402,8 @@ def _bias_spec(bias4, h, block_q, block_k, *, swapped):
 
 
 def _seed_spec():
-    """Dropout seed: a (1,) fp32 scalar in SMEM, shared by every block."""
+    """Dropout seed: a (2,) fp32 ``(hi, lo)`` pair in SMEM (see
+    ``_pack_seed``), shared by every block."""
     return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
@@ -667,9 +690,21 @@ def _make_flash(scale: float, causal: bool, block_q: int, block_k: int,
     return flash
 
 
+def _auto_block(seq: int, choices=(512, 256, 128)) -> int:
+    """Largest tile from ``choices`` dividing ``seq`` (0 if none divide —
+    the caller then falls back to XLA). 512x512 blocks measured ~4x faster
+    than 128x128 on v5e (fewer grid steps, better MXU occupancy; bench
+    seq=4096: 26.5ms vs 123ms fwd+bwd, XLA 86.5ms)."""
+    for c in choices:
+        if seq % c == 0:
+            return c
+    return 0
+
+
 def flash_attention(q, k, v, bias=None, causal: bool = False,
                     softmax_scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     use_pallas: Optional[bool] = None,
                     bias_requires_grad: bool = False,
                     dropout_rate: float = 0.0,
@@ -700,6 +735,10 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
         softmax_scale = 1.0 / math.sqrt(d)
     if dropout_rate > 0.0 and dropout_seed is None:
         raise ValueError("dropout_rate > 0 requires dropout_seed")
+    if block_q is None:
+        block_q = _auto_block(sq, (512, 256, 128, 64, 32, 16, 8)) or 128
+    if block_k is None:
+        block_k = _auto_block(sk) or 128
     if use_pallas is None:
         use_pallas = supports_flash(sq, sk, d, block_q, block_k)
     if not use_pallas:
@@ -731,12 +770,12 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
     else:
         bias4 = jnp.zeros((), jnp.float32)  # placeholder pytree leaf
     if dropout_rate > 0.0:
-        # fp32 seed scalar (SMEM-friendly, and a differentiable placeholder
-        # for custom_vjp); 24-bit space composed with per-element counters
-        seed = jnp.reshape(
-            jnp.asarray(dropout_seed) % (1 << 24), (1,)).astype(jnp.float32)
+        # (hi, lo) fp32 pair (SMEM-friendly and a differentiable
+        # placeholder for custom_vjp); full 32-bit seed space composed with
+        # per-element counters (ADVICE r2: was 24-bit)
+        seed = _pack_seed(dropout_seed)
     else:
-        seed = jnp.zeros((1,), jnp.float32)
+        seed = jnp.zeros((2,), jnp.float32)
     fn = _make_flash(float(softmax_scale), bool(causal), block_q, block_k,
                      has_bias, bool(bias_requires_grad), h,
                      float(dropout_rate))

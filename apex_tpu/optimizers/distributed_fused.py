@@ -40,24 +40,36 @@ from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from apex_tpu.optimizers._base import OptimizerBase, bias_correction
+from apex_tpu.optimizers._flatten import (FlatLayout, build_layout, ravel,
+                                          segment_ids, unravel)
 
 __all__ = ["DistributedFusedAdam", "DistributedFusedLAMB",
            "ZeroAdamState", "ZeroLambState"]
 
 
-class _FlatLayout(NamedTuple):
-    treedef: Any
-    shapes: Tuple[Tuple[int, ...], ...]
-    dtypes: Tuple[Any, ...]
-    sizes: Tuple[int, ...]
-    offsets: Tuple[int, ...]
-    total: int
-    padded: int
-    shard: int            # padded // dp
-    dp: int
+def _all_gather_invariant(shard: jnp.ndarray, axis_name: str,
+                          padded: int, chunk: int) -> jnp.ndarray:
+    """Invariant-typed tiled all-gather of per-rank flat shards.
+
+    The gathered vector is replicated by construction (every rank contributes
+    its disjoint shard), and typing it device-invariant lets callers keep
+    ``P()`` out_specs for params — a plain ``all_gather``'s varying type would
+    fail shard_map's replication check. ``all_gather_invariant`` is private
+    JAX API (``jax._src.lax.parallel``), so it is wrapped here with an
+    equivalent — but slower, O(world x padded) traffic — public-API fallback:
+    place the shard at its offset in a zero vector and psum (disjoint one-hot
+    sum)."""
+    try:
+        from jax._src.lax.parallel import all_gather_invariant
+    except ImportError:  # pragma: no cover - private symbol moved
+        rank = jax.lax.axis_index(axis_name)
+        return jax.lax.psum(
+            jax.lax.dynamic_update_slice_in_dim(
+                jnp.zeros(padded, shard.dtype), shard, rank * chunk, axis=0),
+            axis_name)
+    return all_gather_invariant(shard, axis_name, axis=0, tiled=True)
 
 
 class ZeroAdamState(NamedTuple):
@@ -72,87 +84,42 @@ ZeroLambState = ZeroAdamState
 
 
 class _DistributedFusedBase(OptimizerBase):
+    """Shared flat-shard plumbing, built on the same
+    :mod:`apex_tpu.optimizers._flatten` layout helpers as
+    :class:`~apex_tpu.optimizers.FlatOptimizer` (``chunks`` = dp here)."""
+
     def __init__(self, axis_name: str = "data"):
         self.axis_name = axis_name
-        self._layout: Optional[_FlatLayout] = None
+        self._layout: Optional[FlatLayout] = None
 
     # -- flat layout ------------------------------------------------------
-    def _build_layout(self, params: Any) -> _FlatLayout:
-        leaves, treedef = jax.tree_util.tree_flatten(params)
-        shapes = tuple(tuple(np.shape(l)) for l in leaves)
-        dtypes = tuple(jnp.asarray(l).dtype for l in leaves)
-        sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
-        offsets = tuple(int(x) for x in np.cumsum((0,) + sizes[:-1]))
-        total = int(sum(sizes))
-        dp = jax.lax.axis_size(self.axis_name)
-        padded = ((total + dp - 1) // dp) * dp
-        return _FlatLayout(treedef, shapes, dtypes, sizes, offsets, total,
-                           padded, padded // dp, dp)
+    def _dp(self, lay: FlatLayout) -> int:
+        return lay.padded // lay.chunk
 
-    def _layout_for(self, params: Any) -> _FlatLayout:
-        lay = self._build_layout(params)
+    def _layout_for(self, params: Any) -> FlatLayout:
+        lay = build_layout(params, chunks=jax.lax.axis_size(self.axis_name))
         if self._layout is not None and (
                 self._layout.shapes != lay.shapes
-                or self._layout.dp != lay.dp):
+                or self._layout.chunk != lay.chunk):
             raise ValueError("parameter structure changed between calls")
         self._layout = lay
         return lay
 
-    def _ravel(self, tree: Any, lay: _FlatLayout) -> jnp.ndarray:
-        leaves = lay.treedef.flatten_up_to(tree)
-        flat = jnp.concatenate(
-            [jnp.reshape(jnp.asarray(l), (-1,)).astype(jnp.float32)
-             for l in leaves])
-        if lay.padded != lay.total:
-            flat = jnp.pad(flat, (0, lay.padded - lay.total))
-        return flat
-
-    def _unravel(self, flat: jnp.ndarray, lay: _FlatLayout) -> Any:
-        leaves = []
-        for shape, dtype, size, off in zip(lay.shapes, lay.dtypes,
-                                           lay.sizes, lay.offsets):
-            leaves.append(jax.lax.dynamic_slice_in_dim(flat, off, size)
-                          .reshape(shape).astype(dtype))
-        return jax.tree_util.tree_unflatten(lay.treedef, leaves)
-
-    def _my_slice(self, flat: jnp.ndarray, lay: _FlatLayout) -> jnp.ndarray:
+    def _my_slice(self, flat: jnp.ndarray, lay: FlatLayout) -> jnp.ndarray:
         rank = jax.lax.axis_index(self.axis_name)
-        return jax.lax.dynamic_slice_in_dim(flat, rank * lay.shard, lay.shard)
+        return jax.lax.dynamic_slice_in_dim(flat, rank * lay.chunk, lay.chunk)
 
-    def _segment_ids(self, lay: _FlatLayout) -> jnp.ndarray:
-        """Static flat-index -> tensor-index map (padding gets an extra id
-        so it never contaminates a real tensor's norm)."""
-        ids = np.full(lay.padded, len(lay.sizes), np.int32)
-        for i, (off, size) in enumerate(zip(lay.offsets, lay.sizes)):
-            ids[off:off + size] = i
-        return jnp.asarray(ids)
-
-    def _shard_grads(self, grads: Any, lay: _FlatLayout) -> jnp.ndarray:
+    def _shard_grads(self, grads: Any, lay: FlatLayout) -> jnp.ndarray:
         """reduce_scatter: flat-averaged grads, this rank's shard only."""
-        flat_g = self._ravel(grads, lay)
+        flat_g = ravel(grads, lay)
         g = jax.lax.psum_scatter(flat_g, self.axis_name, scatter_dimension=0,
                                  tiled=True)
-        return g / lay.dp
+        return g / self._dp(lay)
 
-    def _gather_params(self, master: jnp.ndarray, lay: _FlatLayout) -> Any:
-        # all_gather_invariant: the gathered params are replicated by
-        # construction, and typing them device-invariant lets callers keep
-        # P() out_specs for params (a plain all_gather's varying type would
-        # fail shard_map's replication check)
-        try:
-            from jax._src.lax.parallel import all_gather_invariant
-            flat = all_gather_invariant(master, self.axis_name, axis=0,
-                                        tiled=True)
-        except ImportError:  # pragma: no cover - private symbol moved
-            # equivalent invariant-typed gather: place the shard at its
-            # offset in a zero vector and psum (disjoint one-hot sum)
-            rank = jax.lax.axis_index(self.axis_name)
-            flat = jax.lax.psum(
-                jax.lax.dynamic_update_slice_in_dim(
-                    jnp.zeros(lay.padded, master.dtype), master,
-                    rank * lay.shard, axis=0),
-                self.axis_name)
-        return self._unravel(flat, lay)
+    def _gather_params(self, master: jnp.ndarray, lay: FlatLayout) -> Any:
+        flat = _all_gather_invariant(master, self.axis_name, lay.padded,
+                                     lay.chunk)
+        return unravel(flat, lay)
 
 
 class DistributedFusedAdam(_DistributedFusedBase):
@@ -177,8 +144,8 @@ class DistributedFusedAdam(_DistributedFusedBase):
 
     def init(self, params: Any) -> ZeroAdamState:
         lay = self._layout_for(params)
-        master = self._my_slice(self._ravel(params, lay), lay)
-        zeros = jnp.zeros(lay.shard, jnp.float32)
+        master = self._my_slice(ravel(params, lay), lay)
+        zeros = jnp.zeros(lay.chunk, jnp.float32)
         return ZeroAdamState(step=jnp.asarray(0, jnp.int32), master=master,
                              exp_avg=zeros, exp_avg_sq=zeros)
 
@@ -235,13 +202,13 @@ class DistributedFusedLAMB(_DistributedFusedBase):
 
     def init(self, params: Any) -> ZeroLambState:
         lay = self._layout_for(params)
-        master = self._my_slice(self._ravel(params, lay), lay)
-        zeros = jnp.zeros(lay.shard, jnp.float32)
+        master = self._my_slice(ravel(params, lay), lay)
+        zeros = jnp.zeros(lay.chunk, jnp.float32)
         return ZeroLambState(step=jnp.asarray(0, jnp.int32), master=master,
                              exp_avg=zeros, exp_avg_sq=zeros)
 
     def _per_tensor(self, vec_sq: jnp.ndarray, seg: jnp.ndarray,
-                    lay: _FlatLayout) -> jnp.ndarray:
+                    lay: FlatLayout) -> jnp.ndarray:
         """psum of shard-local segment sums -> per-tensor sums (n_tensors+1,
         last slot is padding)."""
         part = jax.ops.segment_sum(vec_sq, seg, num_segments=len(lay.sizes) + 1)
@@ -263,7 +230,7 @@ class DistributedFusedLAMB(_DistributedFusedBase):
         else:
             bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
         b1, b2 = self.beta1, self.beta2
-        seg = self._my_slice(self._segment_ids(lay), lay)
+        seg = self._my_slice(segment_ids(lay), lay)
 
         g = self._shard_grads(grads, lay)
         # phase 1: global grad-norm clip (reference fused_lamb.py:124-152)

@@ -54,6 +54,15 @@ class NumMicroBatchesCalculator(ABC):
     def update(self, consumed_samples, consistency_check) -> None:
         ...
 
+    # -- checkpointing (host_state sidecar of apex_tpu.checkpoint) --------
+    def state_dict(self) -> dict:
+        return {"num_micro_batches": self.num_micro_batches,
+                "current_global_batch_size": self.current_global_batch_size}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.num_micro_batches = state["num_micro_batches"]
+        self.current_global_batch_size = state["current_global_batch_size"]
+
 
 class ConstantNumMicroBatches(NumMicroBatchesCalculator):
     def __init__(self, global_batch_size: int, micro_batch_size: int,

@@ -142,6 +142,7 @@ def pipelined_apply(
     num_chunks: int = 1,
     remat: bool = False,
     last_stage_fn: Optional[Callable] = None,
+    embed_fn: Optional[Callable] = None,
 ) -> jnp.ndarray:
     """Run ``microbatches`` through the virtual pipeline; returns the
     per-microbatch outputs of the final global stage, shape ``(M, ...)``.
@@ -155,17 +156,36 @@ def pipelined_apply(
       stage ``c*S + d``,
       ``fwd_bwd_pipelining_with_interleaving.py:122-131``).
     - ``microbatches``: ``(M, ...)`` fed to global stage 0; activations keep
-      this trailing shape through every stage.
+      this trailing shape through every stage unless ``embed_fn`` maps them
+      first.
     - ``last_stage_fn(y, m_index) -> out`` — applied to the final stage's
       output (e.g. loss head); defaults to identity.
+    - ``embed_fn(microbatch) -> activation`` — the first-stage input
+      transform (e.g. token embedding), the ``pre_process`` role of
+      ``build_model`` (:schedules/common.py:29-148). With it, microbatches
+      may have any shape/dtype (e.g. int tokens); the pipelined activation
+      is ``embed_fn``'s output. Under SPMD every rank traces the embed (the
+      program is stage-uniform) and only stage 0's result is consumed — the
+      lookup is negligible next to a transformer stage.
     """
     S = jax.lax.axis_size(PIPE_AXIS)
     rank = jax.lax.axis_index(PIPE_AXIS)
-    M = microbatches.shape[0]
+    M = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
     L = S * num_chunks
     T = M + L - 1
-    act_shape = microbatches.shape[1:]
-    act_dtype = microbatches.dtype
+    if embed_fn is None:
+        if not isinstance(microbatches, jnp.ndarray):
+            raise ValueError(
+                "pytree microbatches require embed_fn to map them to the "
+                "pipelined activation")
+        act_shape = microbatches.shape[1:]
+        act_dtype = microbatches.dtype
+    else:
+        mb0 = jax.tree_util.tree_map(
+            lambda v: jax.lax.index_in_dim(v, 0, 0, keepdims=False),
+            microbatches)
+        act_aval = jax.eval_shape(embed_fn, mb0)
+        act_shape, act_dtype = act_aval.shape, act_aval.dtype
 
     def chunk_params_at(c: int):
         return jax.tree_util.tree_map(
@@ -179,9 +199,13 @@ def pipelined_apply(
             x = buf[c]
             if c == 0:
                 # global stage 0 = device 0 chunk 0 consumes fresh microbatch
-                fresh = jax.lax.dynamic_index_in_dim(
-                    microbatches, jnp.clip(t, 0, M - 1), 0, keepdims=False)
-                x = jnp.where(rank == 0, fresh, x)
+                fresh = jax.tree_util.tree_map(
+                    lambda v: jax.lax.dynamic_index_in_dim(
+                        v, jnp.clip(t, 0, M - 1), 0, keepdims=False),
+                    microbatches)
+                if embed_fn is not None:
+                    fresh = embed_fn(fresh)
+                x = jnp.where(rank == 0, fresh.astype(act_dtype), x)
             g_stage = c * S + rank
             fn = stage_fn
             if remat:
@@ -236,23 +260,66 @@ def pipelined_apply(
 # ---------------------------------------------------------------------------
 
 def _pipelined_fwd_bwd(stage_fn, loss_fn, stage_params, microbatches,
-                       num_chunks, forward_only, remat, grad_scale):
+                       num_chunks, forward_only, remat, grad_scale,
+                       shared_params=None, embed_fn=None):
     """Shared driver: loss = mean over microbatches of
     ``loss_fn(final_stage_output, m)``, computed at the last stage and
-    psum-shared over ``pipe``; grads via AD through the scan."""
+    psum-shared over ``pipe``; grads via AD through the scan.
+
+    ``shared_params`` (optional) are pipe-replicated parameters consumed by
+    ``embed_fn(shared, microbatch)`` on global stage 0 and by
+    ``loss_fn(shared, y, m)`` on the last stage — the pipelined embedding +
+    tied output head. Because shared params enter ``shard_map`` replicated
+    (device-invariant type), AD itself inserts the cross-stage psum that
+    makes their cotangent invariant again — the reference's embedding-group
+    allreduce (first + last stage contributions,
+    ``reference:apex/transformer/parallel_state.py:215-247``,
+    ``schedules/common.py:29-148`` pre/post_process) falls out of the VMA
+    type system rather than being an explicit collective here (verified
+    against a single-device reference in
+    ``tests/test_transformer_parallel.py::test_gpt_pipelined_embedding_and_tied_head``).
+    """
+    if embed_fn is not None and shared_params is None:
+        raise ValueError(
+            "embed_fn takes (shared_params, microbatch); pass the embedding "
+            "parameters via shared_params so they are differentiated")
+    m = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
+
     def total_loss(params):
         # pipelined_apply already broadcasts the final stage's outputs over
         # the pipe axis, so the loss is replicated by construction
-        outs = pipelined_apply(stage_fn, params, microbatches,
-                               num_chunks=num_chunks, remat=remat)
-        m = microbatches.shape[0]
-        losses = jax.vmap(loss_fn)(outs, jnp.arange(m))
+        if shared_params is None:
+            outs = pipelined_apply(stage_fn, params, microbatches,
+                                   num_chunks=num_chunks, remat=remat)
+            losses = jax.vmap(loss_fn)(outs, jnp.arange(m))
+        else:
+            stages, shared = params
+            ef = (lambda mb: embed_fn(shared, mb)) \
+                if embed_fn is not None else None
+            outs = pipelined_apply(stage_fn, stages, microbatches,
+                                   num_chunks=num_chunks, remat=remat,
+                                   embed_fn=ef)
+            losses = jax.vmap(lambda y, i: loss_fn(shared, y, i))(
+                outs, jnp.arange(m))
+            # the head runs "for real" only on the last stage (the broadcast
+            # outs make every rank compute an identical copy): masking the
+            # loss here (a) matches the reference's loss-on-last-stage and
+            # (b) routes the head's shared-param cotangent to rank S-1 only,
+            # so the psum below counts it exactly once
+            rank = jax.lax.axis_index(PIPE_AXIS)
+            S = jax.lax.axis_size(PIPE_AXIS)
+            total = jnp.mean(losses)
+            return jax.lax.psum(
+                jnp.where(rank == S - 1, total, jnp.zeros_like(total)),
+                PIPE_AXIS)
         return jnp.mean(losses)
 
+    diff_params = stage_params if shared_params is None \
+        else (stage_params, shared_params)
     if forward_only:
-        return total_loss(stage_params), None
+        return total_loss(diff_params), None
     loss, grads = jax.value_and_grad(
-        lambda p: total_loss(p) * grad_scale)(stage_params)
+        lambda p: total_loss(p) * grad_scale)(diff_params)
     grads = jax.tree_util.tree_map(
         lambda g: (g / grad_scale).astype(jnp.float32), grads)
     return loss / grad_scale, grads
@@ -267,20 +334,31 @@ def forward_backward_pipelining_without_interleaving(
     forward_only: bool = False,
     remat: bool = False,
     grad_scale: Any = 1.0,
+    shared_params: Any = None,
+    embed_fn: Optional[Callable] = None,
 ):
-    """1F1B-equivalent schedule (``fwd_bwd_pipelining_without_interleaving.py:155-345``).
+    """Pipelined schedule, output-equivalent to 1F1B
+    (``fwd_bwd_pipelining_without_interleaving.py:155-345``); see
+    ``pipelined_apply`` for the memory profile vs true 1F1B.
 
     ``forward_step_func(stage_params, x, stage_index) -> y`` is the uniform
     stage body; ``loss_fn(final_output, microbatch_index) -> scalar``.
     ``params`` leaves must NOT carry a chunk axis (single chunk per stage).
     Returns ``(mean_loss, grads)`` — grads for this device's stage params.
+
+    With ``shared_params``/``embed_fn`` (pipelined embedding + tied head, see
+    ``_pipelined_fwd_bwd``), ``loss_fn(shared, y, m)`` and grads are
+    ``(stage_grads, shared_grads)`` with shared_grads psummed over ``pipe``.
     """
     chunked = jax.tree_util.tree_map(lambda p: p[None], params)
     loss, grads = _pipelined_fwd_bwd(
         forward_step_func, loss_fn, chunked, batch, 1, forward_only, remat,
-        grad_scale)
+        grad_scale, shared_params=shared_params, embed_fn=embed_fn)
     if grads is not None:
-        grads = jax.tree_util.tree_map(lambda g: g[0], grads)
+        stage_grads = grads[0] if shared_params is not None else grads
+        stage_grads = jax.tree_util.tree_map(lambda g: g[0], stage_grads)
+        grads = (stage_grads, grads[1]) if shared_params is not None \
+            else stage_grads
     return loss, grads
 
 
@@ -294,6 +372,8 @@ def forward_backward_pipelining_with_interleaving(
     forward_only: bool = False,
     remat: bool = False,
     grad_scale: Any = 1.0,
+    shared_params: Any = None,
+    embed_fn: Optional[Callable] = None,
 ):
     """Interleaved virtual-pipeline schedule
     (``fwd_bwd_pipelining_with_interleaving.py:25-375``): each device holds
@@ -302,7 +382,8 @@ def forward_backward_pipelining_with_interleaving(
     ``(num_model_chunks, ...)`` axis."""
     return _pipelined_fwd_bwd(
         forward_step_func, loss_fn, params, batch, num_model_chunks,
-        forward_only, remat, grad_scale)
+        forward_only, remat, grad_scale, shared_params=shared_params,
+        embed_fn=embed_fn)
 
 
 def get_forward_backward_func(virtual_pipeline_model_parallel_size: Optional[int],

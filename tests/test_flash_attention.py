@@ -249,3 +249,37 @@ def test_unaligned_falls_back():
     out = flash_attention(q, k, v)  # auto-fallback, must not raise
     ref = mha_reference(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_dbias_learned_bias_with_dropout(dtype):
+    """ADVICE r2: bias_requires_grad=True together with dropout_rate>0 —
+    the dropout branch of the dbias kernel (ds rebuilt from the dropped
+    probabilities) must match the XLA fallback, in fp32 and with bf16
+    q/k/v."""
+    q, k, v = _qkv(b=2, h=2, sq=128, sk=128, seed=23)
+    q, k, v = (x.astype(dtype) for x in (q, k, v))
+    bias = jnp.asarray(np.random.RandomState(24).randn(1, 2, 128, 128) * 0.1,
+                       jnp.float32)
+    dy = jnp.asarray(np.random.RandomState(25).randn(*q.shape), jnp.float32)
+
+    def f(bias, use_pallas):
+        return jnp.sum(flash_attention(
+            q, k, v, bias=bias, causal=True, use_pallas=use_pallas,
+            bias_requires_grad=True, dropout_rate=0.3,
+            dropout_seed=987654321) * dy)
+
+    db_flash = jax.grad(lambda b: f(b, True))(bias)
+    db_ref = jax.grad(lambda b: f(b, False))(bias)
+    tol = 2e-4 if dtype == jnp.float32 else 2e-2
+    np.testing.assert_allclose(np.asarray(db_flash), np.asarray(db_ref),
+                               rtol=tol, atol=tol)
+
+
+def test_seed_uses_full_32_bits():
+    """ADVICE r2: seeds differing only above bit 24 must give different
+    masks (the old fp32 carrier truncated to 24 bits)."""
+    from apex_tpu.ops.flash_attention import dropout_keep_mask
+    m1 = np.asarray(dropout_keep_mask(1, 1, 1, 64, 128, 0.5))
+    m2 = np.asarray(dropout_keep_mask(1 + (1 << 25), 1, 1, 64, 128, 0.5))
+    assert (m1 != m2).any()

@@ -256,3 +256,65 @@ def test_optax_adapter():
     for a, b in zip(jax.tree_util.tree_leaves(new_p),
                     jax.tree_util.tree_leaves(direct_p)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+@pytest.mark.parametrize("inner_cls,kw", [
+    (opt_mod.FusedSGD, dict(lr=0.1, momentum=0.9, weight_decay=1e-4)),
+    (opt_mod.FusedAdam, dict(lr=1e-2, weight_decay=0.1)),
+    (opt_mod.FusedAdagrad, dict(lr=1e-2)),
+])
+@pytest.mark.parametrize("dtype", [np.float32, "bfloat16"])
+def test_flat_optimizer_parity(inner_cls, kw, dtype):
+    """FlatOptimizer(inner) == inner over a multi-leaf tree, for fp32 and
+    bf16 params. Both paths widen (grad, param) to fp32 inside the update and
+    cast back to the param dtype, so flattening commutes with the elementwise
+    math and parity is essentially bitwise."""
+    dtype = jnp.bfloat16 if dtype == "bfloat16" else dtype
+    params = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x, dtype), _rand_tree(11))
+    grads = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x, dtype), _rand_tree(1100))
+
+    ref_opt = inner_cls(**kw)
+    ref_state = ref_opt.init(params)
+    flat_opt = opt_mod.FlatOptimizer(inner_cls(**kw))
+    flat_state = flat_opt.init(params)
+
+    rp = fp = params
+    for step in range(3):
+        g = jax.tree_util.tree_map(lambda x: x * (step + 1.0), grads)
+        rp, ref_state = ref_opt.step(g, ref_state, rp)
+        fp, flat_state = flat_opt.step(g, flat_state, fp)
+    for a, b in zip(jax.tree_util.tree_leaves(fp),
+                    jax.tree_util.tree_leaves(rp)):
+        assert a.dtype == dtype
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=1e-6, atol=1e-7)
+
+
+def test_flat_optimizer_overflow_skip_and_jit():
+    params = jax.tree_util.tree_map(jnp.asarray, _rand_tree(12))
+    opt = opt_mod.FlatOptimizer(opt_mod.FusedSGD(lr=0.1, momentum=0.9))
+    state = opt.init(params)
+    grads = jax.tree_util.tree_map(jnp.asarray, _rand_tree(1200))
+    bad = dict(grads, w=grads["w"].at[0, 0].set(jnp.inf))
+
+    @jax.jit
+    def train_step(g, s, p):
+        return opt.step(g, s, p, grads_finite=all_finite(g))
+
+    new_p, new_s = train_step(bad, state, params)
+    for a, b in zip(jax.tree_util.tree_leaves(new_p),
+                    jax.tree_util.tree_leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    new_p, new_s = train_step(grads, new_s, new_p)
+    assert not np.allclose(np.asarray(new_p["w"]), np.asarray(params["w"]))
+
+
+def test_flat_optimizer_rejects_structure_change():
+    params = jax.tree_util.tree_map(jnp.asarray, _rand_tree(13))
+    opt = opt_mod.FlatOptimizer(opt_mod.FusedSGD(lr=0.1))
+    opt.init(params)
+    with pytest.raises(ValueError):
+        opt.init({"w": params["w"]})
