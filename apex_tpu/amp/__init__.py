@@ -7,6 +7,9 @@ optimizers, a :class:`Policy` describes the dtypes, and
 train step. See also ``apex_tpu.fp16_utils`` for the legacy-API shims.
 """
 
+from apex_tpu.amp.lists import (  # noqa: F401
+    casts_are_enabled, disable_casts, o1_context, register_float_function,
+    register_half_function, register_promote_function)
 from apex_tpu.amp.policy import (
     O0,
     O1,
@@ -37,4 +40,7 @@ __all__ = [
     "with_policy",
     "LossScaleState", "DynamicLossScale", "StaticLossScale", "NoOpLossScale",
     "make_loss_scale", "all_finite", "select_tree", "scaled_value_and_grad",
+    "o1_context", "disable_casts", "casts_are_enabled",
+    "register_half_function", "register_float_function",
+    "register_promote_function",
 ]

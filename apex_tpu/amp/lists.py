@@ -1,0 +1,191 @@
+"""O1 per-op cast lists + runtime patching.
+
+Reference: ``reference:apex/amp/lists/torch_overrides.py`` /
+``functional_overrides.py`` / ``tensor_overrides.py`` (the policy tables:
+which ops run fp16, which fp32, which promote to the widest input type) and
+the registration escape hatches ``register_half_function`` /
+``register_float_function`` / ``register_promote_function``
+(``reference:apex/amp/amp.py:30-64``), applied by wrapping the listed
+callables at ``amp.init`` time (``amp.py:68-177``, ``wrap.py:10-112``).
+
+TPU framing: wholesale-policy casting (:mod:`apex_tpu.amp.policy`) covers
+the common case — XLA fuses the casts, and bf16 removes fp16's range traps.
+The per-op tables still matter for (a) fp16 workflows that need exp/log/
+softmax/norm in fp32, (b) third-party functional code you cannot edit but
+can call under :func:`o1_context`, and (c) API parity. The mechanism is the
+same as the reference's: the listed functions are wrapped (module attribute
+swapped) for the duration of the context, with cast-to-half on the
+matmul/conv class, cast-to-fp32 on the numerically-sensitive class, and
+widest-input promotion on the mixed-input class. ``disable_casts`` gives
+the reference's escape to raw behavior (``reference:apex/amp/handle.py:163-167``).
+
+The default tables translate the reference lists to the JAX namespace:
+
+- FP16 (``torch_overrides.py:7-27``: conv*/BLAS):  ``jnp.matmul``,
+  ``jnp.dot``, ``jnp.vdot``, ``jnp.inner``, ``jnp.tensordot``,
+  ``jnp.einsum``, ``jax.lax.conv_general_dilated``, ``jax.lax.dot_general``.
+- FP32 (``torch_overrides.py:29-59``: transcendental + reductions + norms):
+  ``jnp.exp``, ``jnp.expm1``, ``jnp.log``, ``jnp.log10``, ``jnp.log1p``,
+  ``jnp.log2``, ``jnp.power``, ``jnp.cosh``, ``jnp.sinh``, ``jnp.sum``,
+  ``jnp.prod``, ``jnp.cumsum``, ``jnp.cumprod``, ``jnp.linalg.norm``,
+  ``jax.nn.softmax``, ``jax.nn.log_softmax``, ``jax.nn.softplus``,
+  ``jax.scipy.special.erf``.
+- PROMOTE (``torch_overrides.py:84-116`` CASTS + SEQUENCE_CASTS):
+  ``jnp.add``, ``jnp.subtract``, ``jnp.multiply``, ``jnp.true_divide``,
+  ``jnp.equal``, ``jnp.concatenate``, ``jnp.stack``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+from typing import Any, Callable, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "register_half_function", "register_float_function",
+    "register_promote_function", "o1_context", "disable_casts",
+    "casts_are_enabled",
+]
+
+_MATH = "half"
+_FP32 = "float"
+_PROMOTE = "promote"
+
+# (module_object, attr_name) -> category; user registrations extend this
+_REGISTRY: List[Tuple[Any, str, str]] = []
+_DEFAULTS_BUILT = False
+_state = threading.local()
+
+
+def _cast_enabled() -> bool:
+    return getattr(_state, "enabled", True)
+
+
+def casts_are_enabled() -> bool:
+    """False inside :func:`disable_casts`."""
+    return _cast_enabled()
+
+
+def _is_float_array(x: Any) -> bool:
+    return hasattr(x, "dtype") and hasattr(x, "shape") and jnp.issubdtype(
+        jnp.asarray(x).dtype if not hasattr(x, "dtype") else x.dtype,
+        jnp.floating)
+
+
+def _cast_tree_to(tree: Any, dtype: Any) -> Any:
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype) if _is_float_array(x) else x, tree)
+
+
+def _widest_float(tree: Any):
+    widest = None
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if _is_float_array(leaf):
+            widest = leaf.dtype if widest is None else jnp.promote_types(
+                widest, leaf.dtype)
+    return widest
+
+
+def _wrap(fn: Callable, category: str, half_dtype) -> Callable:
+    """The cast wrapper (``reference:apex/amp/wrap.py:10-112``): cast float
+    array arguments, call, return. Output dtype is whatever the op produces
+    from its cast inputs — matching the reference, which casts inputs only."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        if not _cast_enabled():
+            return fn(*args, **kwargs)
+        if category == _MATH:
+            target = half_dtype
+        elif category == _FP32:
+            target = jnp.float32
+        else:  # promote: widest floating dtype among the inputs
+            target = _widest_float((args, kwargs))
+        if target is not None:
+            args, kwargs = _cast_tree_to((args, kwargs), target)
+        return fn(*args, **kwargs)
+
+    wrapped.__amp_wrapped__ = fn
+    return wrapped
+
+
+def register_half_function(module: Any, name: str) -> None:
+    """Run ``module.<name>`` in the half dtype under :func:`o1_context`
+    (``reference:apex/amp/amp.py:30-39``)."""
+    _REGISTRY.append((module, name, _MATH))
+
+
+def register_float_function(module: Any, name: str) -> None:
+    """Run ``module.<name>`` in fp32 under :func:`o1_context`
+    (``reference:apex/amp/amp.py:42-50``)."""
+    _REGISTRY.append((module, name, _FP32))
+
+
+def register_promote_function(module: Any, name: str) -> None:
+    """Promote mixed inputs of ``module.<name>`` to the widest float dtype
+    (``reference:apex/amp/amp.py:53-64``)."""
+    _REGISTRY.append((module, name, _PROMOTE))
+
+
+def _build_default_registry() -> None:
+    global _DEFAULTS_BUILT
+    if _DEFAULTS_BUILT:
+        return
+    _DEFAULTS_BUILT = True
+    for name in ("matmul", "dot", "vdot", "inner", "tensordot", "einsum"):
+        register_half_function(jnp, name)
+    register_half_function(jax.lax, "conv_general_dilated")
+    register_half_function(jax.lax, "dot_general")
+    for name in ("exp", "expm1", "log", "log10", "log1p", "log2", "power",
+                 "cosh", "sinh", "sum", "prod", "cumsum", "cumprod"):
+        register_float_function(jnp, name)
+    register_float_function(jnp.linalg, "norm")
+    for name in ("softmax", "log_softmax", "softplus"):
+        register_float_function(jax.nn, name)
+    register_float_function(jax.scipy.special, "erf")
+    for name in ("add", "subtract", "multiply", "true_divide", "equal",
+                 "concatenate", "stack"):
+        register_promote_function(jnp, name)
+
+
+@contextlib.contextmanager
+def o1_context(half_dtype: Any = jnp.bfloat16):
+    """Patch the registered functions with their cast wrappers — the
+    functional scope of ``amp.init()``'s namespace patching
+    (``reference:apex/amp/amp.py:68-177``). Code called inside the context
+    (including code about to be traced by ``jit``) sees the patched ops;
+    on exit every attribute is restored.
+
+    Note the tracing caveat: the patching is Python-level, so it applies to
+    functions *traced* inside the context. A function jitted (and cached)
+    outside keeps its original behavior.
+    """
+    _build_default_registry()
+    originals = []
+    try:
+        for module, name, category in _REGISTRY:
+            fn = getattr(module, name)
+            if hasattr(fn, "__amp_wrapped__"):
+                continue  # already patched (nested contexts)
+            originals.append((module, name, fn))
+            setattr(module, name, _wrap(fn, category, jnp.dtype(half_dtype)))
+        yield
+    finally:
+        for module, name, fn in reversed(originals):
+            setattr(module, name, fn)
+
+
+@contextlib.contextmanager
+def disable_casts():
+    """Temporarily run everything un-cast inside an :func:`o1_context`
+    (``reference:apex/amp/handle.py:163-167``)."""
+    prev = _cast_enabled()
+    _state.enabled = False
+    try:
+        yield
+    finally:
+        _state.enabled = prev

@@ -204,3 +204,74 @@ class TestScaledValueAndGrad:
         restored = jax.tree_util.tree_unflatten(treedef, [np.asarray(x) for x in flat])
         assert float(restored.loss_scale) == float(st.loss_scale)
         assert int(restored.unskipped) == int(st.unskipped)
+
+
+# ---------------------------------------------------------------------------
+# O1 per-op cast lists (reference:apex/amp/lists, tests/L0/run_amp/
+# test_basic_casts.py + test_promotion.py)
+# ---------------------------------------------------------------------------
+
+class TestO1CastLists:
+    def test_half_list_casts_matmul(self):
+        from apex_tpu.amp import o1_context
+        a = jnp.ones((4, 4), jnp.float32)
+        with o1_context(jnp.bfloat16):
+            out = jnp.matmul(a, a)
+        assert out.dtype == jnp.bfloat16
+        # restored on exit
+        assert jnp.matmul(a, a).dtype == jnp.float32
+
+    def test_float_list_casts_exp_softmax(self):
+        from apex_tpu.amp import o1_context
+        x = jnp.ones((8,), jnp.bfloat16)
+        with o1_context(jnp.bfloat16):
+            assert jnp.exp(x).dtype == jnp.float32
+            assert jax.nn.softmax(x).dtype == jnp.float32
+            assert jnp.sum(x).dtype == jnp.float32
+        assert jnp.exp(x).dtype == jnp.bfloat16
+
+    def test_promote_list_widest_type(self):
+        from apex_tpu.amp import o1_context
+        lo = jnp.ones((4,), jnp.bfloat16)
+        hi = jnp.ones((4,), jnp.float32)
+        with o1_context(jnp.bfloat16):
+            assert jnp.add(lo, hi).dtype == jnp.float32
+            assert jnp.concatenate([lo, hi]).dtype == jnp.float32
+            assert jnp.stack([lo, lo]).dtype == jnp.bfloat16
+
+    def test_register_escape_hatch(self):
+        import types
+        from apex_tpu.amp import o1_context, register_float_function
+        mod = types.SimpleNamespace(myop=lambda x: x * 2)
+        register_float_function(mod, "myop")
+        x = jnp.ones((3,), jnp.bfloat16)
+        with o1_context(jnp.bfloat16):
+            assert mod.myop(x).dtype == jnp.float32
+        assert mod.myop(x).dtype == jnp.bfloat16
+
+    def test_disable_casts(self):
+        from apex_tpu.amp import casts_are_enabled, disable_casts, o1_context
+        a = jnp.ones((4, 4), jnp.float32)
+        with o1_context(jnp.bfloat16):
+            with disable_casts():
+                assert not casts_are_enabled()
+                assert jnp.matmul(a, a).dtype == jnp.float32
+            assert casts_are_enabled()
+            assert jnp.matmul(a, a).dtype == jnp.bfloat16
+
+    def test_works_under_jit_trace(self):
+        from apex_tpu.amp import o1_context
+        a = jnp.ones((4, 4), jnp.float32)
+        with o1_context(jnp.bfloat16):
+            out = jax.jit(lambda a: jnp.matmul(a, a))(a)
+        assert out.dtype == jnp.bfloat16
+
+    def test_nested_context_no_double_wrap(self):
+        from apex_tpu.amp import o1_context
+        a = jnp.ones((4, 4), jnp.float32)
+        with o1_context(jnp.bfloat16):
+            with o1_context(jnp.bfloat16):
+                assert jnp.matmul(a, a).dtype == jnp.bfloat16
+            # inner exit must not unwrap the outer patch
+            assert jnp.matmul(a, a).dtype == jnp.bfloat16
+        assert jnp.matmul(a, a).dtype == jnp.float32
