@@ -167,6 +167,20 @@ def pipelined_apply(
       is ``embed_fn``'s output. Under SPMD every rank traces the embed (the
       program is stage-uniform) and only stage 0's result is consumed — the
       lookup is negligible next to a transformer stage.
+
+    **Memory profile (measured, see tests/test_pipeline_memory.py).** This
+    schedule is *output*-equivalent to the reference's 1F1B, not
+    memory-equivalent: AD of the tick scan stores residuals for every tick,
+    so backward activation memory is **O((M + L) per-tick residual)** per
+    device, while the reference's interleaved fwd/bwd
+    (``fwd_bwd_pipelining_without_interleaving.py:155-345``) keeps at most
+    O(L) microbatches in flight. What ``remat=True`` guarantees: each
+    tick's residual shrinks to the carry (one activation per local chunk) —
+    intra-stage activations are recomputed in backward — measured ~4x per-
+    microbatch reduction on a 3-matmul stage and exactly the
+    carry-per-tick bound asserted in the test. For memory-bound configs
+    keep M modest per call (grad-accumulate across calls) or pass
+    ``remat=True``.
     """
     S = jax.lax.axis_size(PIPE_AXIS)
     rank = jax.lax.axis_index(PIPE_AXIS)
