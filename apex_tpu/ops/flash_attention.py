@@ -779,5 +779,6 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
     fn = _make_flash(float(softmax_scale), bool(causal), block_q, block_k,
                      has_bias, bool(bias_requires_grad), h,
                      float(dropout_rate))
-    out = fn(q3, k3, v3, bias4, seed)
+    with jax.named_scope("flash_attention"):
+        out = fn(q3, k3, v3, bias4, seed)
     return out.reshape(b, h, sq, d)

@@ -86,6 +86,7 @@ def allreduce_grads(grads: Any, axis_name: str = "data",
         world = jax.lax.axis_size(axis_name)
     pre = gradient_predivide_factor
 
+    @jax.named_scope("apex_ddp_allreduce")
     def _sync(g):
         g = jnp.asarray(g)
         orig_dtype = g.dtype

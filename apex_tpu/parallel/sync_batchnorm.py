@@ -76,17 +76,19 @@ def sync_batch_norm(
 
     if training:
         # local partial sums; one psum merges count-weighted across devices
-        local_count = jnp.asarray(
-            _prod(x.shape[i] for i in red), jnp.float32)
-        s1 = jnp.sum(xf, axis=red)
-        s2 = jnp.sum(xf * xf, axis=red)
-        if axis_name is not None:
-            from apex_tpu.parallel.distributed import grouped_psum
-            s1 = grouped_psum(s1, axis_name, axis_index_groups)
-            s2 = grouped_psum(s2, axis_name, axis_index_groups)
-            count = grouped_psum(local_count, axis_name, axis_index_groups)
-        else:
-            count = local_count
+        with jax.named_scope("sync_bn_stats"):
+            local_count = jnp.asarray(
+                _prod(x.shape[i] for i in red), jnp.float32)
+            s1 = jnp.sum(xf, axis=red)
+            s2 = jnp.sum(xf * xf, axis=red)
+            if axis_name is not None:
+                from apex_tpu.parallel.distributed import grouped_psum
+                s1 = grouped_psum(s1, axis_name, axis_index_groups)
+                s2 = grouped_psum(s2, axis_name, axis_index_groups)
+                count = grouped_psum(local_count, axis_name,
+                                     axis_index_groups)
+            else:
+                count = local_count
         mean = s1 / count
         var = s2 / count - mean * mean  # biased, used for normalization
         unbiased = var * count / jnp.maximum(count - 1.0, 1.0)

@@ -206,6 +206,7 @@ def pipelined_apply(
             lambda p: jax.lax.index_in_dim(p, c, 0, keepdims=False),
             stage_params)
 
+    @jax.named_scope("pipeline_tick")
     def tick(buf, t):
         # buf: (num_chunks, *act_shape) — input activation per local chunk
         outs = []
