@@ -1,0 +1,225 @@
+"""Unified training config tree.
+
+The reference carries three config systems (SURVEY §5): amp's ``Properties``
+policy object (``reference:apex/amp/frontend.py:7-97``), the 808-line
+Megatron argparse namespace (``reference:apex/transformer/testing/
+arguments.py`` + process-global ``get_args()``), and setup.py build flags.
+Here they collapse into one typed dataclass tree with plain constructors —
+no globals, no argparse, no feature-detect imports (every op has an XLA
+path; Pallas selection is a runtime capability check).
+
+``TrainConfig`` is the single object a trainer needs: it *builds* the
+pieces (model, optimizer, policy, scaler, microbatch calculator, samplers)
+rather than being threaded into them, so each subsystem keeps its explicit
+functional API. ``to_dict``/``from_dict`` give a JSON-serializable form for
+the checkpoint ``host_state`` sidecar.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Tuple
+
+__all__ = ["ModelConfig", "ParallelConfig", "BatchConfig", "OptimizerConfig",
+           "TrainConfig"]
+
+
+def _asdict(obj) -> dict:
+    return dataclasses.asdict(obj)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Network-size args (``arguments.py`` ``_add_network_size_args``)."""
+    name: str = "gpt"                 # "gpt" | "bert" | "resnet50"
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 1024
+    ffn_hidden_size: Optional[int] = None
+    hidden_dropout: float = 0.0
+    attention_dropout: float = 0.0
+    num_classes: int = 1000           # resnet head
+    remat: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """Mesh axes (``arguments.py`` ``_add_distributed_args`` /
+    ``parallel_state.initialize_model_parallel``)."""
+    tensor_model_parallel_size: int = 1
+    pipeline_model_parallel_size: int = 1
+    virtual_pipeline_model_parallel_size: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchConfig:
+    """Batch sizing (``arguments.py`` ``_add_training_args`` +
+    ``microbatches.py``)."""
+    global_batch_size: int = 64
+    micro_batch_size: int = 8
+    rampup_batch_size: Optional[Tuple[int, int, int]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """Optimizer selection (``arguments.py`` ``_add_learning_rate_args``)."""
+    name: str = "adam"                # adam|adamw|sgd|lamb|novograd|adagrad
+    lr: float = 1e-4
+    weight_decay: float = 0.01
+    betas: Tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-8
+    momentum: float = 0.9             # sgd
+    flat: bool = False                # wrap in FlatOptimizer
+    zero: bool = False                # DistributedFused* over the data axis
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    model: ModelConfig = ModelConfig()
+    parallel: ParallelConfig = ParallelConfig()
+    batch: BatchConfig = BatchConfig()
+    optimizer: OptimizerConfig = OptimizerConfig()
+    opt_level: str = "O2"             # amp policy preset
+    half_dtype: str = "bfloat16"
+    seed: int = 1234
+
+    # -- serialization ----------------------------------------------------
+    def to_dict(self) -> dict:
+        return _asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TrainConfig":
+        d = dict(d)
+        for field, sub in (("model", ModelConfig),
+                           ("parallel", ParallelConfig),
+                           ("batch", BatchConfig),
+                           ("optimizer", OptimizerConfig)):
+            if field in d and isinstance(d[field], dict):
+                sub_d = dict(d[field])
+                if field == "optimizer" and "betas" in sub_d:
+                    sub_d["betas"] = tuple(sub_d["betas"])
+                if field == "batch" and sub_d.get("rampup_batch_size"):
+                    sub_d["rampup_batch_size"] = tuple(
+                        sub_d["rampup_batch_size"])
+                d[field] = sub(**sub_d)
+        return cls(**d)
+
+    # -- builders ---------------------------------------------------------
+    def build_policy(self):
+        import jax.numpy as jnp
+
+        from apex_tpu.amp import get_policy
+        half = jnp.bfloat16 if self.half_dtype == "bfloat16" else jnp.float16
+        return get_policy(self.opt_level, half_dtype=half)
+
+    def build_scaler(self):
+        """Loss-scale object implied by the policy (may be a no-op)."""
+        from apex_tpu.amp import make_loss_scale
+        return make_loss_scale(self.build_policy().loss_scale)
+
+    def build_model(self):
+        import jax.numpy as jnp
+
+        pol = self.build_policy()
+        m = self.model
+        if m.name == "gpt":
+            from apex_tpu.models import GPTConfig, GPTModel
+            return GPTModel(GPTConfig(
+                vocab_size=m.vocab_size, hidden_size=m.hidden_size,
+                num_layers=m.num_layers,
+                num_attention_heads=m.num_attention_heads,
+                max_position_embeddings=m.max_position_embeddings,
+                ffn_hidden_size=m.ffn_hidden_size,
+                tensor_model_parallel_size=
+                self.parallel.tensor_model_parallel_size,
+                params_dtype=pol.param_dtype,
+                compute_dtype=pol.compute_dtype,
+                hidden_dropout=m.hidden_dropout,
+                attention_dropout=m.attention_dropout, remat=m.remat))
+        if m.name == "bert":
+            from apex_tpu.models import BertConfig, BertModel
+            return BertModel(BertConfig(
+                vocab_size=m.vocab_size, hidden_size=m.hidden_size,
+                num_layers=m.num_layers,
+                num_attention_heads=m.num_attention_heads,
+                max_position_embeddings=m.max_position_embeddings,
+                compute_dtype=pol.compute_dtype))
+        if m.name == "resnet50":
+            from apex_tpu.models import ResNet50, ResNetConfig
+            return ResNet50(ResNetConfig(
+                num_classes=m.num_classes, compute_dtype=pol.compute_dtype,
+                params_dtype=pol.param_dtype))
+        raise ValueError(f"unknown model {m.name!r}")
+
+    def build_optimizer(self):
+        from apex_tpu import optimizers as opt
+
+        o = self.optimizer
+        if o.zero:
+            if o.name in ("adam", "adamw"):
+                return opt.DistributedFusedAdam(
+                    lr=o.lr, betas=o.betas, eps=o.eps,
+                    adam_w_mode=o.name == "adamw",
+                    weight_decay=o.weight_decay)
+            if o.name == "lamb":
+                return opt.DistributedFusedLAMB(
+                    lr=o.lr, betas=o.betas, eps=o.eps,
+                    weight_decay=o.weight_decay)
+            raise ValueError(f"no ZeRO variant of {o.name!r}")
+        if o.name in ("adam", "adamw"):
+            inner = opt.FusedAdam(lr=o.lr, betas=o.betas, eps=o.eps,
+                                  adam_w_mode=o.name == "adamw",
+                                  weight_decay=o.weight_decay)
+        elif o.name == "sgd":
+            inner = opt.FusedSGD(lr=o.lr, momentum=o.momentum,
+                                 weight_decay=o.weight_decay)
+        elif o.name == "lamb":
+            inner = opt.FusedLAMB(lr=o.lr, betas=o.betas, eps=o.eps,
+                                  weight_decay=o.weight_decay)
+        elif o.name == "novograd":
+            inner = opt.FusedNovoGrad(lr=o.lr, betas=o.betas, eps=o.eps,
+                                      weight_decay=o.weight_decay)
+        elif o.name == "adagrad":
+            inner = opt.FusedAdagrad(lr=o.lr,
+                                     weight_decay=o.weight_decay)
+        else:
+            raise ValueError(f"unknown optimizer {o.name!r}")
+        return opt.FlatOptimizer(inner) if o.flat else inner
+
+    def build_microbatch_calculator(self, data_parallel_size: int):
+        from apex_tpu.transformer.pipeline_parallel.microbatches import (
+            build_num_microbatches_calculator)
+        ram = (list(self.batch.rampup_batch_size)
+               if self.batch.rampup_batch_size else None)
+        return build_num_microbatches_calculator(
+            rank=0, rampup_batch_size=ram,
+            global_batch_size=self.batch.global_batch_size,
+            micro_batch_size=self.batch.micro_batch_size,
+            data_parallel_size=data_parallel_size)
+
+    def build_sampler(self, total_samples: int, consumed_samples: int,
+                      data_parallel_rank: int, data_parallel_size: int,
+                      shuffle: bool = False):
+        from apex_tpu.transformer._data import (
+            MegatronPretrainingRandomSampler, MegatronPretrainingSampler)
+        local = self.batch.global_batch_size // data_parallel_size
+        cls = (MegatronPretrainingRandomSampler if shuffle
+               else MegatronPretrainingSampler)
+        return cls(total_samples=total_samples,
+                   consumed_samples=consumed_samples,
+                   local_minibatch_size=local,
+                   data_parallel_rank=data_parallel_rank,
+                   data_parallel_size=data_parallel_size)
+
+    def initialize_mesh(self, devices=None):
+        from apex_tpu.transformer import parallel_state
+        return parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size=
+            self.parallel.tensor_model_parallel_size,
+            pipeline_model_parallel_size=
+            self.parallel.pipeline_model_parallel_size,
+            virtual_pipeline_model_parallel_size=
+            self.parallel.virtual_pipeline_model_parallel_size,
+            devices=devices)

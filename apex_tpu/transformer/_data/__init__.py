@@ -1,0 +1,6 @@
+"""Megatron-style data samplers (``reference:apex/transformer/_data/``)."""
+
+from apex_tpu.transformer._data.batchsampler import (  # noqa: F401
+    MegatronPretrainingRandomSampler, MegatronPretrainingSampler)
+
+__all__ = ["MegatronPretrainingSampler", "MegatronPretrainingRandomSampler"]
