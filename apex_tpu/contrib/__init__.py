@@ -1,0 +1,16 @@
+"""apex_tpu.contrib — optional feature packages (reference:
+``apex/contrib``): sparsity (ASP), transducer re-exports.
+
+Unlike the reference there are no compiled extensions to feature-detect;
+each subpackage imports on demand.
+"""
+
+import importlib as _importlib
+
+_LAZY = ("sparsity",)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        return _importlib.import_module(f"apex_tpu.contrib.{name}")
+    raise AttributeError(f"module 'apex_tpu.contrib' has no attribute {name!r}")

@@ -1,0 +1,11 @@
+"""ASP — automatic structured (2:4) sparsity.
+
+Reference: ``reference:apex/contrib/sparsity/asp.py:28-44`` and the mask
+pattern library ``sparse_masklib.py``.
+"""
+
+from apex_tpu.contrib.sparsity.asp import (  # noqa: F401
+    ASP, compute_sparse_masks, apply_masks, mn_1d_mask, sparse_parameter_paths)
+
+__all__ = ["ASP", "compute_sparse_masks", "apply_masks", "mn_1d_mask",
+           "sparse_parameter_paths"]

@@ -1,0 +1,141 @@
+"""ASP — automatic 2:4 structured sparsity, functionally.
+
+Reference: ``reference:apex/contrib/sparsity/asp.py:28-44`` —
+``init_model_for_pruning`` attaches mask buffers to whitelisted
+Linear/Conv modules, ``init_optimizer_for_pruning`` monkey-patches
+``optimizer.step`` to re-apply masks after every update, and
+``compute_sparse_masks`` fills the buffers with the "m4n2_1d" pattern
+(``sparse_masklib.py:37-66``: per group of 4 consecutive weights along the
+input dim, keep the 2 largest magnitudes). The permutation-search quality
+recovery (``permutation_lib.py``) targets sparse tensor-core MMA layout on
+Ampere; TPUs have no 2:4 sparse MMA, so ASP here serves the *pruning
+workflow* (train dense → mask → finetune sparse → deploy), and permutation
+search is intentionally out of scope.
+
+Functional shape: masks are a boolean pytree mirroring (a whitelisted
+subset of) the params — they live beside the params, ride through
+:mod:`apex_tpu.checkpoint` like any other state (the role of the buffer
+registration + the checkpoint tests
+``reference:apex/contrib/sparsity/test/checkpointing_test_part1/2.py``),
+and the mask-reapplying optimizer step is a wrapper that zeroes the masked
+entries of params (and grads) around the inner update.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ASP", "compute_sparse_masks", "apply_masks", "mn_1d_mask",
+           "sparse_parameter_paths"]
+
+
+def mn_1d_mask(w: jnp.ndarray, m: int = 4, n: int = 2) -> jnp.ndarray:
+    """n:m mask along the last axis: in every group of ``m`` consecutive
+    elements keep the ``n`` largest |w| (``sparse_masklib.py:37-49``
+    ``mn_1d_best``/``m4n2_1d``; exact per-group top-n, not the heuristic
+    pattern search)."""
+    if w.shape[-1] % m:
+        raise ValueError(f"last dim {w.shape[-1]} not divisible by m={m}")
+    groups = jnp.abs(w).reshape(*w.shape[:-1], w.shape[-1] // m, m)
+    # rank within each group; keep the n largest magnitudes
+    order = jnp.argsort(groups, axis=-1)          # ascending
+    ranks = jnp.argsort(order, axis=-1)
+    keep = ranks >= (m - n)
+    return keep.reshape(w.shape)
+
+
+def _default_whitelist(path: Tuple, leaf: jnp.ndarray, m: int) -> bool:
+    """The Linear/Conv whitelist, structurally: float weights with >= 2
+    dims whose last dim is m-divisible and reasonably large (the reference
+    skips tiny layers the same way)."""
+    if not (hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype,
+                                                      jnp.floating)):
+        return False
+    if leaf.ndim < 2 or leaf.shape[-1] % m or leaf.shape[-1] < 16:
+        return False
+    name = jax.tree_util.keystr(path).lower()
+    blocked = ("bias", "norm", "bn", "ln", "embedding")
+    return not any(b in name for b in blocked)
+
+
+def sparse_parameter_paths(params: Any, m: int = 4,
+                           whitelist: Optional[Callable] = None) -> List[str]:
+    """Which leaves ASP would prune (diagnostic; the role of
+    ``__sparse_parameters``)."""
+    wl = whitelist or _default_whitelist
+    return [jax.tree_util.keystr(p)
+            for p, l in jax.tree_util.tree_leaves_with_path(params)
+            if wl(p, l, m)]
+
+
+def compute_sparse_masks(params: Any, m: int = 4, n: int = 2,
+                         whitelist: Optional[Callable] = None) -> Any:
+    """Mask pytree: n:m boolean masks for whitelisted leaves, all-True for
+    the rest (``ASP.compute_sparse_masks``)."""
+    wl = whitelist or _default_whitelist
+
+    def one(path, leaf):
+        if wl(path, leaf, m):
+            return mn_1d_mask(leaf, m, n)
+        return jnp.ones(jnp.shape(leaf), bool)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def apply_masks(params: Any, masks: Any) -> Any:
+    """Zero the pruned entries (applied after every optimizer step)."""
+    return jax.tree_util.tree_map(
+        lambda p, msk: jnp.where(msk, p, jnp.zeros_like(p))
+        if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else p,
+        params, masks)
+
+
+class ASP:
+    """Workflow object (``asp.py:28-44``):
+
+        asp = ASP()
+        masks = asp.compute_sparse_masks(params)    # flip sparsity on
+        opt = asp.init_optimizer_for_pruning(opt, masks)
+        params = asp.prune(params, masks)           # one-time prune
+        ... normal training; opt.step re-applies masks every update ...
+
+    ``masks`` is ordinary state: checkpoint it next to the params
+    (bool leaves survive :mod:`apex_tpu.checkpoint` untouched).
+    """
+
+    def __init__(self, m: int = 4, n: int = 2,
+                 whitelist: Optional[Callable] = None):
+        self.m, self.n = m, n
+        self.whitelist = whitelist
+
+    def compute_sparse_masks(self, params: Any) -> Any:
+        return compute_sparse_masks(params, self.m, self.n, self.whitelist)
+
+    def prune(self, params: Any, masks: Any) -> Any:
+        return apply_masks(params, masks)
+
+    def init_optimizer_for_pruning(self, optimizer: Any, masks: Any) -> Any:
+        """Wrap ``optimizer.step`` so masked entries stay zero after every
+        update (the monkey-patched ``step`` of
+        ``reference:apex/contrib/sparsity/asp.py`` ``init_optimizer_for_
+        pruning``). Grads of pruned entries are zeroed first so momentum
+        never accumulates for dead weights."""
+        return _MaskedOptimizer(optimizer, masks)
+
+
+class _MaskedOptimizer:
+    def __init__(self, inner: Any, masks: Any):
+        self.inner = inner
+        self.masks = masks
+
+    def init(self, params: Any) -> Any:
+        return self.inner.init(params)
+
+    def step(self, grads: Any, state: Any, params: Any, **kw):
+        grads = apply_masks(grads, self.masks)
+        new_params, new_state = self.inner.step(grads, state, params, **kw)
+        return apply_masks(new_params, self.masks), new_state

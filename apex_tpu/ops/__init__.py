@@ -13,6 +13,8 @@ from apex_tpu.ops.focal_loss import FocalLoss, focal_loss  # noqa: F401
 from apex_tpu.ops.fused_softmax import (  # noqa: F401
     AttnMaskType, FusedScaleMaskSoftmax, scaled_masked_softmax,
     scaled_upper_triang_masked_softmax)
+from apex_tpu.ops.transducer import (  # noqa: F401
+    TransducerJoint, TransducerLoss, transducer_joint, transducer_loss)
 from apex_tpu.ops.mlp import (  # noqa: F401
     MLP, FusedDense, FusedDenseGeluDense, fused_dense,
     fused_dense_gelu_dense, mlp_forward)
@@ -27,4 +29,6 @@ __all__ = [
     "MLP", "FusedDense", "FusedDenseGeluDense", "fused_dense",
     "fused_dense_gelu_dense", "mlp_forward",
     "SoftmaxCrossEntropyLoss", "softmax_cross_entropy_loss",
+    "TransducerJoint", "TransducerLoss", "transducer_joint",
+    "transducer_loss",
 ]

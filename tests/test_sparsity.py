@@ -1,0 +1,92 @@
+"""ASP 2:4 sparsity tests (``reference:apex/contrib/sparsity/test/``:
+``toy_problem.py`` + ``checkpointing_test_part1/2.py`` roles)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.sparsity import (ASP, apply_masks,
+                                       compute_sparse_masks, mn_1d_mask,
+                                       sparse_parameter_paths)
+from apex_tpu.optimizers import FusedAdam
+
+
+def test_mn_1d_mask_keeps_top2_of_4():
+    w = jnp.asarray([[0.1, -0.9, 0.5, 0.01, 4.0, 1.0, -2.0, 3.0]])
+    mask = np.asarray(mn_1d_mask(w))
+    assert mask.tolist() == [[False, True, True, False,
+                              True, False, False, True]]
+    # exactly n per group, always
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(7, 32))
+    m = np.asarray(mn_1d_mask(w)).reshape(7, 8, 4)
+    assert np.all(m.sum(-1) == 2)
+
+
+def test_whitelist_skips_bias_norm_and_small():
+    params = {
+        "dense": {"weight": jnp.ones((16, 32)), "bias": jnp.ones(32)},
+        "ln": {"weight": jnp.ones((4, 32))},
+        "tiny": jnp.ones((4, 8)),
+    }
+    paths = sparse_parameter_paths(params)
+    assert any("dense" in p and "weight" in p for p in paths)
+    assert not any("bias" in p or "ln" in p or "tiny" in p for p in paths)
+
+    masks = compute_sparse_masks(params)
+    assert np.asarray(masks["dense"]["bias"]).all()
+    pruned = apply_masks(params, masks)
+    dw = np.asarray(pruned["dense"]["weight"]).reshape(16, 8, 4)
+    assert np.all((dw != 0).sum(-1) == 2)
+
+
+def test_masked_optimizer_keeps_sparsity_and_converges():
+    """Toy problem (``toy_problem.py`` role): prune, finetune with the
+    mask-reapplying step, and check sparsity is invariant while loss
+    drops."""
+    rng = np.random.RandomState(1)
+    params = {"w": jnp.asarray(rng.randn(32, 32) * 0.5)}
+    x = jnp.asarray(rng.randn(64, 32))
+    y = jnp.asarray(rng.randn(64, 32))
+
+    asp = ASP()
+    masks = asp.compute_sparse_masks(params)
+    params = asp.prune(params, masks)
+    opt = asp.init_optimizer_for_pruning(FusedAdam(lr=1e-2), masks)
+    state = opt.init(params)
+
+    def loss_fn(p):
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    @jax.jit
+    def step(p, s):
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        p, s = opt.step(g, s, p)
+        return p, s, loss
+
+    losses = []
+    for _ in range(60):
+        params, state, loss = step(params, state)
+        losses.append(float(loss))
+    w = np.asarray(params["w"]).reshape(32, 8, 4)
+    assert np.all((w != 0).sum(-1) <= 2)  # 2:4 pattern held every step
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_masks_survive_checkpoint(tmp_path):
+    """``checkpointing_test_part1/2.py``: masks ride the checkpoint as
+    ordinary state and resume bit-identically."""
+    from apex_tpu.checkpoint import restore_checkpoint, save_checkpoint
+
+    params = {"w": jnp.asarray(np.random.RandomState(2).randn(16, 16))}
+    masks = compute_sparse_masks(params)
+    save_checkpoint(str(tmp_path), {"params": params, "masks": masks},
+                    step=0)
+    restored, _ = restore_checkpoint(str(tmp_path),
+                                     {"params": params, "masks": masks})
+    np.testing.assert_array_equal(np.asarray(restored["masks"]["w"]),
+                                  np.asarray(masks["w"]))
+    pruned = apply_masks(restored["params"], restored["masks"])
+    np.testing.assert_array_equal(np.asarray(pruned["w"]),
+                                  np.asarray(apply_masks(params, masks)["w"]))
