@@ -149,15 +149,25 @@ class DynamicLossScale:
                               unskipped=new_unskipped.astype(jnp.int32))
 
 
-@dataclasses.dataclass(frozen=True)
 class StaticLossScale:
-    """Constant loss scale (``reference:apex/fp16_utils/loss_scaler.py:10-44``)."""
+    """Constant loss scale (``reference:apex/fp16_utils/loss_scaler.py:10-44``).
 
-    scale: float = 1.0
+    Not a dataclass: the scale *value* rides in ``init_scale`` so the
+    ``scale(state, tree)`` method keeps the same protocol as
+    :class:`DynamicLossScale` (a ``scale`` field would shadow it).
+    """
+
+    def __init__(self, scale: float = 1.0):
+        self.init_scale = float(scale)
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self.init_scale == other.init_scale)
 
     def init(self) -> LossScaleState:
-        return LossScaleState(loss_scale=jnp.asarray(self.scale, jnp.float32),
-                              unskipped=jnp.asarray(0, jnp.int32))
+        return LossScaleState(
+            loss_scale=jnp.asarray(self.init_scale, jnp.float32),
+            unskipped=jnp.asarray(0, jnp.int32))
 
     def scale(self, state, tree):
         return DynamicLossScale.scale(self, state, tree)  # type: ignore[arg-type]

@@ -126,7 +126,7 @@ class TestLossScale:
         assert isinstance(amp.make_loss_scale(None), amp.NoOpLossScale)
         assert isinstance(amp.make_loss_scale("dynamic"), amp.DynamicLossScale)
         s = amp.make_loss_scale(64.0)
-        assert isinstance(s, amp.StaticLossScale) and s.scale == 64.0
+        assert isinstance(s, amp.StaticLossScale) and s.init_scale == 64.0
 
 
 class TestScaledValueAndGrad:
