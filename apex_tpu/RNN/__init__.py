@@ -1,0 +1,22 @@
+"""Deprecated stub (SURVEY §7.7): ``apex.RNN`` has no TPU port.
+
+The reference package (``reference:apex/RNN/``) is a deprecated
+fp16-friendly RNN/LSTM/GRU/mLSTM reimplementation whose upstream docs say
+"use torch.nn RNNs". The TPU-native migration:
+
+- plain ``flax.linen.LSTMCell``/``GRUCell`` under ``jax.lax.scan`` —
+  fp16/bf16-safe out of the box (XLA accumulates in fp32);
+- per-op precision control via :func:`apex_tpu.amp.o1_context` if a cast
+  policy is needed.
+
+Any attribute access raises with this guidance.
+"""
+
+_MSG = ("apex_tpu.RNN is a documented stub: the reference package is "
+        "deprecated. Use flax.linen LSTM/GRU cells under jax.lax.scan "
+        "(bf16-safe natively); see apex_tpu/RNN/__init__.py for the "
+        "migration notes.")
+
+
+def __getattr__(name):
+    raise NotImplementedError(_MSG)

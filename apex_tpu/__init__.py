@@ -30,7 +30,7 @@ import importlib as _importlib
 _LAZY_SUBMODULES = (
     "optimizers", "normalization", "ops", "parallel", "transformer",
     "contrib", "utils", "fp16_utils", "models", "multi_tensor_apply",
-    "RNN", "reparameterization", "checkpoint", "config",
+    "RNN", "reparameterization", "checkpoint", "config", "pyprof",
 )
 
 

@@ -1,0 +1,98 @@
+"""fp16_utils — the pre-amp manual mixed-precision surface, as a thin
+adapter over the modern pieces.
+
+Reference: ``reference:apex/fp16_utils/`` — ``FP16_Optimizer``
+(``fp16_optimizer.py:13-554``), ``network_to_half``/``convert_network``
+(``fp16util.py:35-80``), ``LossScaler``/``DynamicLossScaler``
+(``loss_scaler.py:10,47``). The reference keeps these for backward
+compatibility and points users at amp; here the module is a *working*
+compatibility shim: every entry point delegates to
+:mod:`apex_tpu.amp` / :mod:`apex_tpu.optimizers`, so legacy-style code
+runs, while new code should use the policy + scaler API directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.policy import cast_floating
+from apex_tpu.amp.scaler import (DynamicLossScale, LossScaleState,
+                                 StaticLossScale, all_finite)
+
+__all__ = ["FP16_Optimizer", "network_to_half", "convert_network",
+           "LossScaler", "DynamicLossScaler", "master_params_to_model_params",
+           "prep_param_lists"]
+
+# loss-scaler aliases: the fp16_utils classes are the static/dynamic
+# scalers of loss_scaler.py:10,47 — same protocol as the amp ones
+LossScaler = StaticLossScale
+DynamicLossScaler = DynamicLossScale
+
+
+def network_to_half(params: Any) -> Any:
+    """Cast float leaves to fp16 (``fp16util.py:35-44``). Prefer bf16 via
+    ``convert_network(params, jnp.bfloat16)`` on TPU."""
+    return cast_floating(params, jnp.float16)
+
+
+def convert_network(params: Any, dtype) -> Any:
+    """``fp16util.py:60-80``: cast float leaves to ``dtype``."""
+    return cast_floating(params, dtype)
+
+
+def prep_param_lists(params: Any) -> Tuple[Any, Any]:
+    """``fp16util.py:97-135``: returns ``(model_params, master_params)`` —
+    here master = fp32 copy of the tree (flat FP32 buffers are the
+    :class:`~apex_tpu.optimizers.FlatOptimizer` tier instead)."""
+    return params, cast_floating(params, jnp.float32)
+
+
+def master_params_to_model_params(model_params: Any, master_params: Any) -> Any:
+    """``fp16util.py:150-162``: copy master values into the model dtypes."""
+    return jax.tree_util.tree_map(
+        lambda mp, ma: ma.astype(mp.dtype) if hasattr(mp, "dtype") else ma,
+        model_params, master_params)
+
+
+class FP16_Optimizer:
+    """Legacy wrapper (``fp16_optimizer.py:13-554``): fp32 master params +
+    loss scaling around any suite optimizer.
+
+    Functional usage (state is explicit, as everywhere in this library)::
+
+        opt = FP16_Optimizer(FusedAdam(lr=1e-3), dynamic_loss_scale=True)
+        state = opt.init(half_params)
+        new_half_params, state = opt.step(grads, state, half_params)
+
+    ``state`` carries ``(master_params_fp32, inner_state, LossScaleState)``;
+    grads may be half (they are unscaled into fp32 before the update, the
+    ``update_master_grads`` path of :436).
+    """
+
+    def __init__(self, inner, static_loss_scale: float = 1.0,
+                 dynamic_loss_scale: bool = False, **scale_kw):
+        self.inner = inner
+        self.scaler = (DynamicLossScale(**scale_kw) if dynamic_loss_scale
+                       else StaticLossScale(static_loss_scale))
+
+    def init(self, params: Any):
+        master = cast_floating(params, jnp.float32)
+        return (master, self.inner.init(master), self.scaler.init())
+
+    def scale_loss(self, state, loss):
+        """The ``optimizer.backward(loss)`` pre-scale (:373)."""
+        return self.scaler.scale(state[2], loss)
+
+    def step(self, grads: Any, state, params: Any,
+             **kw) -> Tuple[Any, Any]:
+        master, inner_state, ls = state
+        grads32 = self.scaler.unscale(ls, grads)
+        finite = all_finite(grads32)
+        new_ls = self.scaler.update(ls, finite)
+        new_master, new_inner = self.inner.step(
+            grads32, inner_state, master, grads_finite=finite, **kw)
+        new_params = master_params_to_model_params(params, new_master)
+        return new_params, (new_master, new_inner, new_ls)

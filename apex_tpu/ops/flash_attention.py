@@ -132,9 +132,28 @@ def supports_flash(sq: int, sk: int, d: int, block_q: int, block_k: int) -> bool
             and block_q % 8 == 0 and block_k % 128 == 0)
 
 
+def _norm_segment_ids(segment_ids, sq, sk):
+    """Accept ``ids (b, s)`` (self-attention) or ``(q_ids, kv_ids)``."""
+    if isinstance(segment_ids, (tuple, list)):
+        q_ids, kv_ids = segment_ids
+    else:
+        if sq != sk:
+            raise ValueError(
+                "cross-attention needs segment_ids=(q_ids, kv_ids)")
+        q_ids = kv_ids = segment_ids
+    q_ids = jnp.asarray(q_ids)
+    kv_ids = jnp.asarray(kv_ids)
+    if q_ids.shape[-1] != sq or kv_ids.shape[-1] != sk:
+        raise ValueError(
+            f"segment id lengths {q_ids.shape[-1]}/{kv_ids.shape[-1]} do "
+            f"not match sequence lengths {sq}/{sk}")
+    return q_ids, kv_ids
+
+
 def mha_reference(q, k, v, bias=None, causal=False,
                   softmax_scale: Optional[float] = None,
-                  dropout_rate: float = 0.0, dropout_seed=None):
+                  dropout_rate: float = 0.0, dropout_seed=None,
+                  segment_ids=None):
     """Plain-XLA attention; the parity reference for the kernel (the role of
     the Python attention in ``reference:apex/contrib/test/fmha/test_fmha.py``).
     With ``dropout_rate > 0`` it applies the *same* counter-based mask as the
@@ -146,12 +165,17 @@ def mha_reference(q, k, v, bias=None, causal=False,
                    preferred_element_type=jnp.float32) * softmax_scale
     if bias is not None:
         s = s + bias.astype(jnp.float32)
+    if segment_ids is not None:
+        q_ids, kv_ids = _norm_segment_ids(segment_ids, q.shape[2], k.shape[2])
+        s = jnp.where((q_ids[:, None, :, None] == kv_ids[:, None, None, :]),
+                      s, NEG_INF)
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
         row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
         s = jnp.where(col > row + (sk - sq), NEG_INF, s)
     p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.max(s, axis=-1, keepdims=True) <= NEG_INF, 0.0, p)
     if dropout_rate > 0.0:
         b, h, sq, sk = p.shape
         keep = dropout_keep_mask(dropout_seed, b, h, sq, sk, dropout_rate)
@@ -164,7 +188,18 @@ def mha_reference(q, k, v, bias=None, causal=False,
 # forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
+def _seg_mask(q_seg_ref, kv_seg_ref):
+    """(block_q, block_k) keep-mask from packed-sequence segment ids — the
+    TPU-native form of the reference's varlen ``cu_seqlens`` packing
+    (``reference:apex/contrib/csrc/fmha/fmha_api.cpp:420``): tokens attend
+    only within their own segment."""
+    q_seg = q_seg_ref[0, 0]        # (block_q,)
+    kv_seg = kv_seg_ref[0, 0]      # (block_k,)
+    return q_seg[:, None] == kv_seg[None, :]
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, q_seg_ref,
+                kv_seg_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k,
                 n_kv, offset, dropout_rate):
     bh, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
@@ -193,6 +228,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
             col = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(col > row + offset, NEG_INF, s)
+        if q_seg_ref is not None:
+            smask = _seg_mask(q_seg_ref, kv_seg_ref)
+            s = jnp.where(smask, s, NEG_INF)
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -200,6 +238,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
             # rows fully masked within a running block have m_new == NEG_INF,
             # so exp(s - m_new) == 1 on masked entries — zero them explicitly
             p = jnp.where(col > row + offset, 0.0, p)
+        if q_seg_ref is not None:
+            p = jnp.where(smask, p, 0.0)
         corr = jnp.exp(m_prev - m_new)
         # softmax normalizer uses the UNdropped probabilities
         l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=1, keepdims=True)
@@ -229,7 +269,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
 # backward kernels
 # ---------------------------------------------------------------------------
 
-def _recompute_p_ds(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, lse_ref,
+def _recompute_p_ds(q_ref, k_ref, v_ref, bias_ref, seed_ref, q_seg_ref,
+                    kv_seg_ref, do_ref, lse_ref,
                     delta_ref, bh, i, j, *, scale, causal, block_q, block_k,
                     offset, dropout_rate):
     """Shared backward recompute: p = exp(s - lse) with causal masking
@@ -253,6 +294,10 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, lse_ref,
         col = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         s = jnp.where(col > row + offset, NEG_INF, s)
+    if q_seg_ref is not None:
+        # masked s = -1e30 underflows through exp(s - lse) whether lse is
+        # finite (row has valid keys) or +inf (fully masked row)
+        s = jnp.where(_seg_mask(q_seg_ref, kv_seg_ref), s, NEG_INF)
     p = jnp.exp(s - lse_ref[0])
     if causal:
         p = jnp.where(col > row + offset, 0.0, p)
@@ -271,7 +316,8 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, lse_ref,
     return p_eff, ds
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, lse_ref,
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, q_seg_ref,
+                   kv_seg_ref, do_ref, lse_ref,
                    delta_ref, dq_ref, dq_acc, *, scale, causal, block_q,
                    block_k, n_kv, offset, dropout_rate):
     bh, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
@@ -285,6 +331,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, lse_ref,
     @pl.when(run)
     def _():
         _, ds = _recompute_p_ds(q_ref, k_ref, v_ref, bias_ref, seed_ref,
+                                q_seg_ref, kv_seg_ref,
                                 do_ref, lse_ref, delta_ref, bh, i, j,
                                 scale=scale, causal=causal, block_q=block_q,
                                 block_k=block_k, offset=offset,
@@ -299,7 +346,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, lse_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _dbias_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, lse_ref,
+def _dbias_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, q_seg_ref,
+                  kv_seg_ref, do_ref, lse_ref,
                   delta_ref, db_ref, *, scale, causal, block_q, block_k,
                   swap, offset, dropout_rate, bh_fn):
     """Accumulate dbias = ds summed over the bias's broadcast dims.
@@ -328,6 +376,7 @@ def _dbias_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, lse_ref,
     @pl.when(run)
     def _():
         _, ds = _recompute_p_ds(q_ref, k_ref, v_ref, bias_ref, seed_ref,
+                                q_seg_ref, kv_seg_ref,
                                 do_ref, lse_ref, delta_ref, bh,
                                 i, j, scale=scale, causal=causal,
                                 block_q=block_q, block_k=block_k,
@@ -338,7 +387,8 @@ def _dbias_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, lse_ref,
             db_ref[0, 0] += ds
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, lse_ref,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, q_seg_ref,
+                    kv_seg_ref, do_ref, lse_ref,
                     delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale,
                     causal, block_q, block_k, n_q, offset, dropout_rate):
     bh = pl.program_id(0)
@@ -354,6 +404,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, lse_ref,
     @pl.when(run)
     def _():
         p, ds = _recompute_p_ds(q_ref, k_ref, v_ref, bias_ref, seed_ref,
+                                q_seg_ref, kv_seg_ref,
                                 do_ref, lse_ref, delta_ref, bh, i, j,
                                 scale=scale, causal=causal, block_q=block_q,
                                 block_k=block_k, offset=offset,
@@ -407,13 +458,30 @@ def _seed_spec():
     return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
-def _fwd_pallas(q3, k3, v3, bias4, seed, h, *, scale, causal, block_q,
+def _seg_specs(h, block_q, block_k, *, swapped):
+    """Specs for packed-segment id arrays ``(b, 1, sq)`` / ``(b, 1, sk)``:
+    one id row per *batch* (shared across heads), blocked along the
+    sequence."""
+    def q_map(b, a, c):
+        i = c if swapped else a
+        return (b // h, 0, i)
+
+    def kv_map(b, a, c):
+        j = a if swapped else c
+        return (b // h, 0, j)
+
+    return (pl.BlockSpec((1, 1, block_q), q_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k), kv_map, memory_space=pltpu.VMEM))
+
+
+def _fwd_pallas(q3, k3, v3, bias4, seed, segs, h, *, scale, causal, block_q,
                 block_k, dropout_rate):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     n_q, n_kv = sq // block_q, sk // block_k
     has_bias = bias4 is not None
     has_drop = dropout_rate > 0.0
+    has_seg = segs is not None
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                           memory_space=pltpu.VMEM)
@@ -427,6 +495,10 @@ def _fwd_pallas(q3, k3, v3, bias4, seed, h, *, scale, causal, block_q,
     if has_drop:
         in_specs.append(_seed_spec())
         args.append(seed)
+    if has_seg:
+        sq_spec, sk_spec = _seg_specs(h, block_q, block_k, swapped=False)
+        in_specs += [sq_spec, sk_spec]
+        args += list(segs)
 
     def kernel(*refs):
         refs = list(refs)
@@ -436,8 +508,12 @@ def _fwd_pallas(q3, k3, v3, bias4, seed, h, *, scale, causal, block_q,
         nxt += has_bias
         seed_ref = refs[nxt] if has_drop else None
         nxt += has_drop
+        qs_ref = refs[nxt] if has_seg else None
+        ks_ref = refs[nxt + 1] if has_seg else None
+        nxt += 2 * has_seg
         o_ref, lse_ref, acc, m, l = refs[nxt:]
-        _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref,
+        _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, qs_ref, ks_ref,
+                    o_ref, lse_ref,
                     acc, m, l, scale=scale, causal=causal, block_q=block_q,
                     block_k=block_k, n_kv=n_kv, offset=sk - sq,
                     dropout_rate=dropout_rate)
@@ -459,13 +535,14 @@ def _fwd_pallas(q3, k3, v3, bias4, seed, h, *, scale, causal, block_q,
     return out, lse
 
 
-def _bwd_pallas(q3, k3, v3, bias4, seed, h, do3, lse, delta, *, scale, causal,
-                block_q, block_k, dropout_rate):
+def _bwd_pallas(q3, k3, v3, bias4, seed, segs, h, do3, lse, delta, *, scale,
+                causal, block_q, block_k, dropout_rate):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     n_q, n_kv = sq // block_q, sk // block_k
     has_bias = bias4 is not None
     has_drop = dropout_rate > 0.0
+    has_seg = segs is not None
 
     # --- dq: grid (bh, n_q, n_kv), kv innermost ---
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
@@ -482,6 +559,10 @@ def _bwd_pallas(q3, k3, v3, bias4, seed, h, do3, lse, delta, *, scale, causal,
     if has_drop:
         in_specs.append(_seed_spec())
         args.append(seed)
+    if has_seg:
+        sq_spec, sk_spec = _seg_specs(h, block_q, block_k, swapped=False)
+        in_specs += [sq_spec, sk_spec]
+        args += list(segs)
     in_specs += [q_spec, row_spec, row_spec]
     args += [do3, lse, delta]
 
@@ -493,8 +574,12 @@ def _bwd_pallas(q3, k3, v3, bias4, seed, h, do3, lse, delta, *, scale, causal,
         nxt += has_bias
         seed_ref = refs[nxt] if has_drop else None
         nxt += has_drop
+        qs_ref = refs[nxt] if has_seg else None
+        ks_ref = refs[nxt + 1] if has_seg else None
+        nxt += 2 * has_seg
         do_ref, lse_ref, delta_ref, dq_ref, dq_acc = refs[nxt:]
-        _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
+        _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, qs_ref,
+                       ks_ref, do_ref,
                        lse_ref, delta_ref, dq_ref, dq_acc, scale=scale,
                        causal=causal, block_q=block_q, block_k=block_k,
                        n_kv=n_kv, offset=sk - sq, dropout_rate=dropout_rate)
@@ -524,6 +609,10 @@ def _bwd_pallas(q3, k3, v3, bias4, seed, h, do3, lse, delta, *, scale, causal,
     if has_drop:
         in_specs2.append(_seed_spec())
         args2.append(seed)
+    if has_seg:
+        sq_spec2, sk_spec2 = _seg_specs(h, block_q, block_k, swapped=True)
+        in_specs2 += [sq_spec2, sk_spec2]
+        args2 += list(segs)
     in_specs2 += [q_spec2, row_spec2, row_spec2]
     args2 += [do3, lse, delta]
 
@@ -535,9 +624,13 @@ def _bwd_pallas(q3, k3, v3, bias4, seed, h, do3, lse, delta, *, scale, causal,
         nxt += has_bias
         seed_ref = refs[nxt] if has_drop else None
         nxt += has_drop
+        qs_ref = refs[nxt] if has_seg else None
+        ks_ref = refs[nxt + 1] if has_seg else None
+        nxt += 2 * has_seg
         (do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc,
          dv_acc) = refs[nxt:]
-        _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
+        _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, qs_ref,
+                        ks_ref, do_ref,
                         lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
                         scale=scale, causal=causal, block_q=block_q,
                         block_k=block_k, n_q=n_q, offset=sk - sq,
@@ -557,10 +650,11 @@ def _bwd_pallas(q3, k3, v3, bias4, seed, h, do3, lse, delta, *, scale, causal,
     return dq, dk, dv
 
 
-def _dbias_pallas(q3, k3, v3, bias4, seed, h, do3, lse, delta, *, scale,
-                  causal, block_q, block_k, dropout_rate):
+def _dbias_pallas(q3, k3, v3, bias4, seed, segs, h, do3, lse, delta, *,
+                  scale, causal, block_q, block_k, dropout_rate):
     """dbias via the accumulating kernel; HBM cost is O(|bias|)."""
     has_drop = dropout_rate > 0.0
+    has_seg = segs is not None
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     n_q, n_kv = sq // block_q, sk // block_k
@@ -624,6 +718,18 @@ def _dbias_pallas(q3, k3, v3, bias4, seed, h, do3, lse, delta, *, scale,
     if has_drop:
         in_specs.append(_seed_spec())
         args.append(seed)
+    if has_seg:
+        def qseg_map(g, a, b_, r):
+            return (bh_of(g, r) // h, 0, ij(a, b_)[0])
+
+        def kseg_map(g, a, b_, r):
+            return (bh_of(g, r) // h, 0, ij(a, b_)[1])
+
+        in_specs += [pl.BlockSpec((1, 1, block_q), qseg_map,
+                                  memory_space=pltpu.VMEM),
+                     pl.BlockSpec((1, 1, block_k), kseg_map,
+                                  memory_space=pltpu.VMEM)]
+        args += list(segs)
     in_specs += [q_spec, row_spec, row_spec]
     args += [do3, lse, delta]
 
@@ -633,8 +739,12 @@ def _dbias_pallas(q3, k3, v3, bias4, seed, h, do3, lse, delta, *, scale,
         nxt = 4
         seed_ref = refs[nxt] if has_drop else None
         nxt += has_drop
+        qs_ref = refs[nxt] if has_seg else None
+        ks_ref = refs[nxt + 1] if has_seg else None
+        nxt += 2 * has_seg
         do_ref, lse_ref, delta_ref, db_ref = refs[nxt:]
-        _dbias_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
+        _dbias_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, qs_ref,
+                      ks_ref, do_ref,
                       lse_ref, delta_ref, db_ref, scale=scale, causal=causal,
                       block_q=block_q, block_k=block_k, swap=swap,
                       offset=sk - sq, dropout_rate=dropout_rate,
@@ -653,30 +763,37 @@ def _dbias_pallas(q3, k3, v3, bias4, seed, h, do3, lse, delta, *, scale,
 @functools.lru_cache(maxsize=None)
 def _make_flash(scale: float, causal: bool, block_q: int, block_k: int,
                 has_bias: bool, need_dbias: bool, h: int,
-                dropout_rate: float):
+                dropout_rate: float, has_seg: bool):
+    def _segs(qs, ks):
+        return (qs, ks) if has_seg else None
+
     @jax.custom_vjp
-    def flash(q3, k3, v3, bias4, seed):
+    def flash(q3, k3, v3, bias4, seed, qseg, kseg):
         out, _ = _fwd_pallas(q3, k3, v3, bias4 if has_bias else None, seed,
+                             _segs(qseg, kseg),
                              h, scale=scale, causal=causal, block_q=block_q,
                              block_k=block_k, dropout_rate=dropout_rate)
         return out
 
-    def fwd(q3, k3, v3, bias4, seed):
+    def fwd(q3, k3, v3, bias4, seed, qseg, kseg):
         out, lse = _fwd_pallas(q3, k3, v3, bias4 if has_bias else None, seed,
+                               _segs(qseg, kseg),
                                h, scale=scale, causal=causal, block_q=block_q,
                                block_k=block_k, dropout_rate=dropout_rate)
-        return out, (q3, k3, v3, bias4, seed, out, lse)
+        return out, (q3, k3, v3, bias4, seed, qseg, kseg, out, lse)
 
     def bwd(res, do3):
-        q3, k3, v3, bias4, seed, out, lse = res
+        q3, k3, v3, bias4, seed, qseg, kseg, out, lse = res
         delta = jnp.sum(do3.astype(jnp.float32) * out.astype(jnp.float32),
                         axis=-1, keepdims=True)
         dq, dk, dv = _bwd_pallas(
-            q3, k3, v3, bias4 if has_bias else None, seed, h, do3, lse,
+            q3, k3, v3, bias4 if has_bias else None, seed,
+            _segs(qseg, kseg), h, do3, lse,
             delta, scale=scale, causal=causal, block_q=block_q,
             block_k=block_k, dropout_rate=dropout_rate)
         if has_bias and need_dbias:
-            dbias = _dbias_pallas(q3, k3, v3, bias4, seed, h, do3, lse,
+            dbias = _dbias_pallas(q3, k3, v3, bias4, seed,
+                                  _segs(qseg, kseg), h, do3, lse,
                                   delta, scale=scale, causal=causal,
                                   block_q=block_q, block_k=block_k,
                                   dropout_rate=dropout_rate)
@@ -684,7 +801,8 @@ def _make_flash(scale: float, causal: bool, block_q: int, block_k: int,
             # documented: zero unless opted in (scalar placeholder when
             # there is no bias at all)
             dbias = jnp.zeros_like(bias4)
-        return dq, dk, dv, dbias, jnp.zeros_like(seed)
+        return (dq, dk, dv, dbias, jnp.zeros_like(seed),
+                jnp.zeros_like(qseg), jnp.zeros_like(kseg))
 
     flash.defvjp(fwd, bwd)
     return flash
@@ -708,8 +826,16 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
                     use_pallas: Optional[bool] = None,
                     bias_requires_grad: bool = False,
                     dropout_rate: float = 0.0,
-                    dropout_seed=None):
+                    dropout_seed=None,
+                    segment_ids=None):
     """Fused attention over ``(b, h, s, d)`` tensors.
+
+    ``segment_ids``: packed-sequence (varlen) attention — the TPU-native
+    form of the reference's ``cu_seqlens`` packing
+    (``reference:apex/contrib/csrc/fmha/fmha_api.cpp:420``). Pass an int
+    array ``(b, s)`` (self-attention) or a ``(q_ids, kv_ids)`` pair; tokens
+    attend only within their own segment, masked blockwise in VMEM (O(b·s)
+    HBM, never O(s²)). Compose with ``causal`` for packed causal LM batches.
 
     ``bias``: additive fp32 score bias broadcastable to ``(b, h, sq, sk)``
     (use ``-10000``-filled masks for padding, as the reference softmax does).
@@ -748,7 +874,8 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
             bias = jax.lax.stop_gradient(bias)
         return mha_reference(q, k, v, bias, causal, softmax_scale,
                              dropout_rate=dropout_rate,
-                             dropout_seed=dropout_seed)
+                             dropout_seed=dropout_seed,
+                             segment_ids=segment_ids)
 
     q3 = q.reshape(b * h, sq, d)
     k3 = k.reshape(b * h, sk, d)
@@ -776,9 +903,18 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
         seed = _pack_seed(dropout_seed)
     else:
         seed = jnp.zeros((2,), jnp.float32)
+    has_seg = segment_ids is not None
+    if has_seg:
+        q_ids, kv_ids = _norm_segment_ids(segment_ids, sq, sk)
+        # fp32 carrier: exact for id counts < 2**24, and custom_vjp wants
+        # float cotangents for every primal
+        qseg = q_ids.astype(jnp.float32).reshape(b, 1, sq)
+        kseg = kv_ids.astype(jnp.float32).reshape(b, 1, sk)
+    else:
+        qseg = kseg = jnp.zeros((), jnp.float32)  # placeholder leaf
     fn = _make_flash(float(softmax_scale), bool(causal), block_q, block_k,
                      has_bias, bool(bias_requires_grad), h,
-                     float(dropout_rate))
+                     float(dropout_rate), has_seg)
     with jax.named_scope("flash_attention"):
-        out = fn(q3, k3, v3, bias4, seed)
+        out = fn(q3, k3, v3, bias4, seed, qseg, kseg)
     return out.reshape(b, h, sq, d)

@@ -1,0 +1,32 @@
+"""Deprecated stub (SURVEY §7.7): the pre-torchrun process launcher.
+
+The reference (``reference:apex/parallel/multiproc.py:5-35``) spawns
+``world_size`` local processes with ``--rank i`` args — a pre-``torchrun``
+convenience that NVIDIA itself deprecated.
+
+On TPU the launcher role is subsumed by SPMD: one Python process per host
+drives all local devices, and multi-host initialization is
+``jax.distributed.initialize()`` (automatic on Cloud TPU). Parallelism is
+expressed in the program (``jax.sharding.Mesh`` +
+``apex_tpu.transformer.parallel_state``), not by spawning ranked
+processes. Running this module prints that guidance and exits non-zero.
+"""
+
+import sys
+
+_MSG = (
+    "apex_tpu.parallel.multiproc is a documented stub: on TPU there is no "
+    "per-rank process launcher. One process per host drives all local "
+    "devices; call jax.distributed.initialize() for multi-host, and "
+    "express DP/TP/PP over a jax.sharding.Mesh "
+    "(apex_tpu.transformer.parallel_state.initialize_model_parallel)."
+)
+
+
+def main() -> int:
+    print(_MSG, file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

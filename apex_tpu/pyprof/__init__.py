@@ -1,0 +1,26 @@
+"""Deprecated stub (SURVEY §7.7): pyprof's NVTX profiling pipeline.
+
+The reference (``reference:apex/pyprof/``, deprecated upstream) implements
+annotate (NVTX monkey-patch) -> trace (nvprof) -> attribute (per-kernel
+FLOP/byte analysis). The TPU-native workflow lives in
+:mod:`apex_tpu.utils.timers`:
+
+- annotate: ``jax.named_scope`` (hot paths in this library are
+  pre-annotated — DDP allreduce, SyncBN stats, pipeline tick, flash
+  attention);
+- trace: :func:`apex_tpu.utils.timers.profile_trace` (``jax.profiler``);
+- attribute: the trace viewer (tensorboard/xprof), or
+  ``jit(f).lower(...).compile().cost_analysis()`` for static FLOP/byte
+  budgets per program.
+
+Any attribute access raises with this guidance.
+"""
+
+_MSG = ("apex_tpu.pyprof is a documented stub: use apex_tpu.utils.timers "
+        "(profile_trace + jax.named_scope + cost_analysis) — see "
+        "apex_tpu/pyprof/__init__.py for the annotate->trace->attribute "
+        "mapping.")
+
+
+def __getattr__(name):
+    raise NotImplementedError(_MSG)

@@ -1,0 +1,23 @@
+"""Deprecated stub (SURVEY §7.7): weight-norm reparameterization.
+
+The reference (``reference:apex/reparameterization/``) implements weight
+normalization via forward pre-hooks — a mutation-based mechanism with no
+functional analog needed: in JAX, reparameterize explicitly::
+
+    def weight_norm(v, g):                  # v: direction, g: magnitude
+        return g * v / jnp.linalg.norm(v, axis=-1, keepdims=True)
+
+    w = weight_norm(params["v"], params["g"])   # inside the model fn
+
+(or use ``flax.linen.WeightNorm``). Any attribute access raises with this
+guidance.
+"""
+
+_MSG = ("apex_tpu.reparameterization is a documented stub: hooks-based "
+        "weight norm has no functional analog. Reparameterize explicitly "
+        "(w = g * v / ||v||) or use flax.linen.WeightNorm; see "
+        "apex_tpu/reparameterization/__init__.py.")
+
+
+def __getattr__(name):
+    raise NotImplementedError(_MSG)

@@ -1,0 +1,74 @@
+"""Deprecated-surface parity: fp16_utils works as a thin adapter; RNN/
+reparameterization/pyprof/multiproc are documented stubs (SURVEY §7.7)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.optimizers import FusedAdam
+
+
+def test_fp16_optimizer_trains_and_skips_overflow():
+    from apex_tpu.fp16_utils import FP16_Optimizer
+
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(16, 16) * 0.3, jnp.float16)}
+    x = jnp.asarray(rng.randn(32, 16), jnp.float16)
+    y = jnp.asarray(rng.randn(32, 16), jnp.float32)
+    opt = FP16_Optimizer(FusedAdam(lr=1e-2), dynamic_loss_scale=True,
+                         init_scale=2.0 ** 8, growth_interval=4)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state, poison):
+        def loss_fn(p):
+            out = (x @ p["w"]).astype(jnp.float32)
+            return jnp.mean((out - y) ** 2) * (1.0 + poison)
+        loss = loss_fn(params)
+        grads = jax.grad(lambda p: opt.scale_loss(state, loss_fn(p)))(params)
+        params, state = opt.step(grads, state, params)
+        return params, state, loss
+
+    losses = []
+    for i in range(15):
+        poison = jnp.asarray(np.inf if i == 4 else 0.0, jnp.float32)
+        before = np.asarray(params["w"])
+        params, state, loss = step(params, state, poison)
+        if i == 4:
+            np.testing.assert_array_equal(np.asarray(params["w"]), before)
+        else:
+            losses.append(float(loss))
+    assert params["w"].dtype == jnp.float16
+    assert state[0]["w"].dtype == jnp.float32  # fp32 masters
+    assert losses[-1] < losses[0] * 0.8
+
+
+def test_fp16_util_helpers():
+    from apex_tpu.fp16_utils import (convert_network,
+                                     master_params_to_model_params,
+                                     network_to_half, prep_param_lists)
+
+    params = {"w": jnp.ones((4, 4), jnp.float32), "step": jnp.asarray(3)}
+    half = network_to_half(params)
+    assert half["w"].dtype == jnp.float16 and half["step"].dtype == jnp.int32
+    assert convert_network(params, jnp.bfloat16)["w"].dtype == jnp.bfloat16
+    model, master = prep_param_lists(half)
+    assert master["w"].dtype == jnp.float32
+    synced = master_params_to_model_params(model, master)
+    assert synced["w"].dtype == jnp.float16
+
+
+def test_stub_packages_raise_with_migration_pointers():
+    import apex_tpu
+
+    for mod_name, needle in [("RNN", "lax.scan"),
+                             ("reparameterization", "WeightNorm"),
+                             ("pyprof", "profile_trace")]:
+        mod = getattr(apex_tpu, mod_name)
+        with pytest.raises(NotImplementedError) as e:
+            mod.anything
+        assert needle in str(e.value)
+
+    from apex_tpu.parallel import multiproc
+    assert multiproc.main() == 1

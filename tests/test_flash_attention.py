@@ -283,3 +283,99 @@ def test_seed_uses_full_32_bits():
     m1 = np.asarray(dropout_keep_mask(1, 1, 1, 64, 128, 0.5))
     m2 = np.asarray(dropout_keep_mask(1 + (1 << 25), 1, 1, 64, 128, 0.5))
     assert (m1 != m2).any()
+
+
+# ---------------------------------------------------------------------------
+# varlen / packed segments (reference:apex/contrib/csrc/fmha/fmha_api.cpp:420
+# cu_seqlens role)
+# ---------------------------------------------------------------------------
+
+def _packed_ids(b, s, boundaries):
+    ids = np.zeros((b, s), np.int32)
+    for bi in range(b):
+        seg = 0
+        for pos in range(s):
+            if pos in boundaries[bi]:
+                seg += 1
+            ids[bi, pos] = seg
+    return jnp.asarray(ids)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_segment_ids_match_reference(causal):
+    """Pallas segment masking == XLA fallback, forward and grads."""
+    q, k, v = _qkv(b=2, h=2, sq=128, sk=128, seed=31)
+    ids = _packed_ids(2, 128, [{40, 90}, {64}])
+    dy = jnp.asarray(np.random.RandomState(32).randn(*q.shape), jnp.float32)
+
+    def f(q, k, v, use_pallas):
+        return jnp.sum(flash_attention(
+            q, k, v, causal=causal, use_pallas=use_pallas,
+            segment_ids=ids) * dy)
+
+    out_p = flash_attention(q, k, v, causal=causal, use_pallas=True,
+                            segment_ids=ids)
+    out_r = flash_attention(q, k, v, causal=causal, use_pallas=False,
+                            segment_ids=ids)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_r),
+                               rtol=2e-4, atol=2e-4)
+    g_p = jax.grad(lambda *a: f(*a, True), argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(lambda *a: f(*a, False), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_p, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_segments_are_isolated():
+    """Packing semantics: segment A's outputs must not change when segment
+    B's tokens change — the property cu_seqlens packing guarantees."""
+    rng = np.random.RandomState(33)
+    q, k, v = _qkv(b=1, h=2, sq=128, sk=128, seed=33)
+    ids = _packed_ids(1, 128, [{64}])
+    base = flash_attention(q, k, v, causal=True, use_pallas=True,
+                           segment_ids=ids)
+    # perturb the SECOND segment's keys/values
+    k2 = k.at[:, :, 64:].set(jnp.asarray(rng.randn(1, 2, 64, 64),
+                                         k.dtype))
+    v2 = v.at[:, :, 64:].set(jnp.asarray(rng.randn(1, 2, 64, 64),
+                                         v.dtype))
+    pert = flash_attention(q, k2, v2, causal=True, use_pallas=True,
+                           segment_ids=ids)
+    np.testing.assert_allclose(np.asarray(base[:, :, :64]),
+                               np.asarray(pert[:, :, :64]),
+                               rtol=1e-5, atol=1e-5)
+    assert not np.allclose(np.asarray(base[:, :, 64:]),
+                           np.asarray(pert[:, :, 64:]))
+
+
+def test_segment_ids_with_dropout_and_bias():
+    """Segments compose with in-kernel dropout and learned-bias grads."""
+    q, k, v = _qkv(b=2, h=2, sq=128, sk=128, seed=34)
+    ids = _packed_ids(2, 128, [{50}, {30, 100}])
+    bias = jnp.asarray(np.random.RandomState(35).randn(1, 2, 128, 128) * 0.1,
+                       jnp.float32)
+    dy = jnp.asarray(np.random.RandomState(36).randn(*q.shape), jnp.float32)
+
+    def f(bias, use_pallas):
+        return jnp.sum(flash_attention(
+            q, k, v, bias=bias, causal=True, use_pallas=use_pallas,
+            bias_requires_grad=True, dropout_rate=0.2, dropout_seed=4242,
+            segment_ids=ids) * dy)
+
+    db_p = jax.grad(lambda b: f(b, True))(bias)
+    db_r = jax.grad(lambda b: f(b, False))(bias)
+    np.testing.assert_allclose(np.asarray(db_p), np.asarray(db_r),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_segment_ids_validation():
+    q, k, v = _qkv(b=1, h=1, sq=128, sk=256, seed=37)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, segment_ids=jnp.zeros((1, 128), jnp.int32))
+    out = flash_attention(
+        q, k, v,
+        segment_ids=(jnp.zeros((1, 128), jnp.int32),
+                     jnp.zeros((1, 256), jnp.int32)))
+    ref = flash_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
