@@ -36,22 +36,52 @@ __all__ = ["allreduce_grads", "DistributedDataParallel", "Reducer",
 def grouped_psum(x: jnp.ndarray, axis_name: str,
                  axis_index_groups: Optional[Sequence[Sequence[int]]] = None
                  ) -> jnp.ndarray:
-    """``psum`` restricted to device subgroups, usable inside ``shard_map``
-    (where ``psum(axis_index_groups=...)`` is not implemented): all_gather the
-    addends, then each device contracts with its group-membership row. The
-    mask contraction is differentiable, so BN/DDP backward through groups
-    works. Groups are the analog of NCCL subgroup ``new_group`` communicators
-    (``reference:apex/parallel/__init__.py:58+``)."""
+    """``psum`` restricted to device subgroups — the analog of NCCL
+    subgroup ``new_group`` communicators
+    (``reference:apex/parallel/__init__.py:58+``).
+
+    Resolution order (all paths differentiable, so BN/DDP backward through
+    groups works):
+
+    1. native ``psum(axis_index_groups=...)`` — currently raises
+       ``NotImplementedError`` inside ``shard_map``; tried first so a
+       future JAX picks it up for free;
+    2. contiguous equal-size groups (how ``create_syncbn_process_group``
+       carves them): ``all_gather`` + a dynamic slice of this rank's group
+       + sum — O(world) traffic, O(group) compute;
+    3. arbitrary groups: ``all_gather`` + membership-mask contraction —
+       O(world) traffic, O(world²·|x|/world) compute; fine for the small
+       stat vectors this is used on, wasteful for large tensors at large
+       world sizes (documented limitation).
+    """
     if axis_index_groups is None:
         return jax.lax.psum(x, axis_name)
+    groups = [list(g) for g in axis_index_groups]
+    try:
+        return jax.lax.psum(x, axis_name, axis_index_groups=groups)
+    except NotImplementedError:
+        pass
     world = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    gathered = jax.lax.all_gather(x, axis_name)  # (world, ...)
+
+    sizes = {len(g) for g in groups}
+    contiguous_equal = (
+        len(sizes) == 1
+        and sorted(i for g in groups for i in g) == list(range(world))
+        and all(g == list(range(g[0], g[0] + len(g))) for g in groups))
+    if contiguous_equal:
+        gsize = sizes.pop()
+        start = (rank // gsize) * gsize
+        mine = jax.lax.dynamic_slice_in_dim(gathered, start, gsize, axis=0)
+        return jnp.sum(mine.astype(jnp.float32), axis=0).astype(x.dtype)
+
     mask = np.zeros((world, world), np.float32)
-    for g in axis_index_groups:
+    for g in groups:
         for i in g:
             for j in g:
                 mask[i, j] = 1.0
-    gathered = jax.lax.all_gather(x, axis_name)  # (world, ...)
-    row = jnp.asarray(mask)[jax.lax.axis_index(axis_name)]
+    row = jnp.asarray(mask)[rank]
     return jnp.tensordot(row, gathered.astype(jnp.float32),
                          axes=1).astype(x.dtype)
 

@@ -574,3 +574,31 @@ def test_gpt_pipelined_embedding_and_tied_head(mesh_pp4):
     # nonzero (lookup path) and differs from an untied-head run's grad
     emb = np.asarray(shared_grads["embedding"]["word"]["weight"])
     assert np.abs(emb).max() > 0
+
+
+def test_stage_predicates_with_explicit_virtual_rank():
+    """Virtual-chunk predicates take the chunk index explicitly (traced or
+    host); the module-global remains reference-API compat only."""
+    mesh = parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size=4,
+        virtual_pipeline_model_parallel_size=2)
+    try:
+        def inner():
+            first = parallel_state.is_pipeline_first_stage(virtual_rank=0)
+            not_first = parallel_state.is_pipeline_first_stage(
+                virtual_rank=1)
+            last = parallel_state.is_pipeline_last_stage(virtual_rank=1)
+            not_last = parallel_state.is_pipeline_last_stage(virtual_rank=0)
+            return tuple(
+                jnp.reshape(v.astype(jnp.int32), (1,))
+                for v in (first, not_first, last, not_last))
+
+        outs = shard_map(inner, mesh=mesh, in_specs=(),
+                         out_specs=(P("pipe"),) * 4)()
+        first, not_first, last, not_last = (np.asarray(o) for o in outs)
+        assert first.tolist() == [1, 0, 0, 0]
+        assert not_first.tolist() == [0, 0, 0, 0]
+        assert last.tolist() == [0, 0, 0, 1]
+        assert not_last.tolist() == [0, 0, 0, 0]
+    finally:
+        parallel_state.destroy_model_parallel()
