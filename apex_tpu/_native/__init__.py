@@ -1,0 +1,140 @@
+"""Native host runtime pieces (C++, ctypes-loaded).
+
+The reference builds its host-side buffer packing as the ``apex_C``
+extension (``reference:csrc/flatten_unflatten.cpp``); this package holds
+the TPU framework's native host equivalents. The shared object is built
+on demand from the checked-in source with the system compiler and cached
+next to it; every entry point has a numpy fallback, so the package
+degrades gracefully where no toolchain exists (mirroring the reference's
+Python-only install story, ``reference:README.md:125-134``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["flatten", "unflatten", "gather_rows", "native_available"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "flatten.cpp")
+_SO = os.path.join(_DIR, "_flatten.so")
+_LIB = None
+_TRIED = False
+
+
+def _build() -> Optional[str]:
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [cxx, "-O3", "-shared", "-fPIC", "-o", _SO, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _SO
+    except Exception:
+        return None
+
+
+def _load():
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    path = _SO if (os.path.exists(_SO)
+                   and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)) \
+        else _build()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.apex_tpu_flatten.restype = ctypes.c_size_t
+        lib.apex_tpu_unflatten.restype = ctypes.c_size_t
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _ptr_array(arrays: Sequence[np.ndarray], writable: bool):
+    ptrs = (ctypes.c_void_p * len(arrays))()
+    sizes = (ctypes.c_size_t * len(arrays))()
+    for i, a in enumerate(arrays):
+        if not a.flags["C_CONTIGUOUS"]:
+            raise ValueError("arrays must be C-contiguous")
+        if writable and not a.flags["WRITEABLE"]:
+            raise ValueError("destination arrays must be writable")
+        ptrs[i] = a.ctypes.data_as(ctypes.c_void_p)
+        sizes[i] = a.nbytes
+    return ptrs, sizes
+
+
+def flatten(arrays: Sequence[np.ndarray]) -> np.ndarray:
+    """Concatenate host arrays into one contiguous uint8 buffer
+    (``apex_C.flatten``)."""
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    total = sum(a.nbytes for a in arrays)
+    out = np.empty(total, np.uint8)
+    lib = _load()
+    if lib is None:
+        off = 0
+        for a in arrays:
+            out[off:off + a.nbytes] = a.view(np.uint8).reshape(-1)
+            off += a.nbytes
+        return out
+    ptrs, sizes = _ptr_array(arrays, writable=False)
+    lib.apex_tpu_flatten(ptrs, sizes, len(arrays),
+                         out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def unflatten(flat: np.ndarray, like: Sequence[np.ndarray]
+              ) -> List[np.ndarray]:
+    """Split a flat uint8 buffer back into arrays shaped/typed like
+    ``like`` (``apex_C.unflatten``)."""
+    flat = np.ascontiguousarray(flat).view(np.uint8).reshape(-1)
+    outs = [np.empty(a.shape, a.dtype) for a in like]
+    total = sum(o.nbytes for o in outs)
+    if flat.nbytes < total:
+        raise ValueError(f"flat buffer too small: {flat.nbytes} < {total}")
+    lib = _load()
+    if lib is None:
+        off = 0
+        for o in outs:
+            o.view(np.uint8).reshape(-1)[:] = flat[off:off + o.nbytes]
+            off += o.nbytes
+        return outs
+    ptrs, sizes = _ptr_array(outs, writable=True)
+    lib.apex_tpu_unflatten(flat.ctypes.data_as(ctypes.c_void_p), ptrs,
+                           sizes, len(outs))
+    return outs
+
+
+def gather_rows(src: np.ndarray, indices: Sequence[int]) -> np.ndarray:
+    """``dst[i] = src[indices[i]]`` over axis 0 — the sampler batch-packing
+    hot path (one memcpy per sample)."""
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(np.asarray(indices, np.int64))
+    if idx.ndim != 1:
+        raise ValueError("indices must be 1-D")
+    if src.ndim < 1:
+        raise ValueError("src must have a leading sample axis")
+    if idx.size and (idx.min() < 0 or idx.max() >= src.shape[0]):
+        raise IndexError("index out of range")
+    out = np.empty((idx.size,) + src.shape[1:], src.dtype)
+    lib = _load()
+    if lib is None:
+        np.take(src, idx, axis=0, out=out)
+        return out
+    row_bytes = src.nbytes // max(src.shape[0], 1)
+    lib.apex_tpu_gather_rows(
+        src.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(row_bytes),
+        idx.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(idx.size),
+        out.ctypes.data_as(ctypes.c_void_p))
+    return out

@@ -1,0 +1,52 @@
+// Host-side buffer packing — the apex_C extension's role
+// (reference:csrc/flatten_unflatten.cpp:15-18, wrapping
+// torch::utils::flatten_dense_tensors).
+//
+// On TPU the *device-side* flatten is jnp.concatenate inside jit (the
+// FlatOptimizer/ZeRO tier); this native module serves the HOST paths the
+// reference also used apex_C for: packing many small numpy buffers into one
+// contiguous staging buffer (checkpoint assembly, sampler batch packing)
+// without Python-loop overhead. Plain C ABI, loaded via ctypes — no
+// pybind11 dependency (not available in this image).
+
+#include <cstddef>
+#include <cstring>
+#include <cstdint>
+
+extern "C" {
+
+// Concatenate n buffers (srcs[i], nbytes[i]) into dst. Returns total bytes.
+size_t apex_tpu_flatten(const void **srcs, const size_t *nbytes, size_t n,
+                        unsigned char *dst) {
+  size_t off = 0;
+  for (size_t i = 0; i < n; ++i) {
+    std::memcpy(dst + off, srcs[i], nbytes[i]);
+    off += nbytes[i];
+  }
+  return off;
+}
+
+// Split src back into n buffers (dsts[i], nbytes[i]). Returns bytes read.
+size_t apex_tpu_unflatten(const unsigned char *src, void **dsts,
+                          const size_t *nbytes, size_t n) {
+  size_t off = 0;
+  for (size_t i = 0; i < n; ++i) {
+    std::memcpy(dsts[i], src + off, nbytes[i]);
+    off += nbytes[i];
+  }
+  return off;
+}
+
+// Gather rows: dst[i, :] = src[indices[i], :] for row_bytes-wide rows —
+// the sampler batch-packing hot path (one memcpy per sample instead of a
+// Python-level fancy-index + copy).
+void apex_tpu_gather_rows(const unsigned char *src, size_t row_bytes,
+                          const int64_t *indices, size_t n,
+                          unsigned char *dst) {
+  for (size_t i = 0; i < n; ++i) {
+    std::memcpy(dst + i * row_bytes,
+                src + static_cast<size_t>(indices[i]) * row_bytes, row_bytes);
+  }
+}
+
+}  // extern "C"

@@ -9,9 +9,10 @@ permutation differs numerically from torch's for the same epoch seed, but
 every invariant — disjointness across ranks, epoch-determinism, exact
 resume — is preserved and tested).
 
-Feeding JAX: each yielded list indexes the host dataset; stack the fetched
-samples and ``jax.device_put`` (or feed through ``tensor_parallel.data.
-broadcast_data`` under TP).
+Feeding JAX: each yielded list indexes the host dataset; pack the rows
+with :func:`apex_tpu._native.gather_rows` (native memcpy batch assembly,
+the host-side analog of apex_C) and ``jax.device_put`` (or feed through
+``tensor_parallel.data.broadcast_data`` under TP).
 """
 
 from __future__ import annotations

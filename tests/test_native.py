@@ -1,0 +1,56 @@
+"""Native host flatten/unflatten/gather (apex_C role,
+``reference:csrc/flatten_unflatten.cpp:15-18``)."""
+
+import numpy as np
+import pytest
+
+from apex_tpu._native import (flatten, gather_rows, native_available,
+                              unflatten)
+
+
+def test_native_builds():
+    """The toolchain exists in CI images; the .so must actually build."""
+    assert native_available()
+
+
+def test_flatten_unflatten_roundtrip():
+    rng = np.random.RandomState(0)
+    arrays = [rng.randn(17, 9).astype(np.float32),
+              rng.randn(4).astype(np.float16),
+              rng.randint(0, 100, (3, 3)).astype(np.int32)]
+    flat = flatten(arrays)
+    assert flat.dtype == np.uint8
+    assert flat.nbytes == sum(a.nbytes for a in arrays)
+    back = unflatten(flat, arrays)
+    for a, b in zip(arrays, back):
+        assert b.dtype == a.dtype and b.shape == a.shape
+        np.testing.assert_array_equal(a, b)
+
+
+def test_unflatten_validates_size():
+    with pytest.raises(ValueError):
+        unflatten(np.zeros(3, np.uint8), [np.zeros((4,), np.float32)])
+
+
+def test_gather_rows_matches_take_and_validates():
+    rng = np.random.RandomState(1)
+    src = rng.randn(64, 7, 3).astype(np.float32)
+    idx = rng.randint(0, 64, 33)
+    np.testing.assert_array_equal(gather_rows(src, idx),
+                                  np.take(src, idx, axis=0))
+    with pytest.raises(IndexError):
+        gather_rows(src, [64])
+
+
+def test_python_fallback_matches_native():
+    import apex_tpu._native as nat
+    rng = np.random.RandomState(2)
+    arrays = [rng.randn(5, 5).astype(np.float32), rng.randn(2).astype(np.float64)]
+    native = flatten(arrays)
+    lib, tried = nat._LIB, nat._TRIED
+    nat._LIB, nat._TRIED = None, True  # force fallback
+    try:
+        fallback = flatten(arrays)
+        np.testing.assert_array_equal(native, fallback)
+    finally:
+        nat._LIB, nat._TRIED = lib, tried
