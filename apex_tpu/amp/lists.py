@@ -71,9 +71,10 @@ def casts_are_enabled() -> bool:
 
 
 def _is_float_array(x: Any) -> bool:
-    return hasattr(x, "dtype") and hasattr(x, "shape") and jnp.issubdtype(
-        jnp.asarray(x).dtype if not hasattr(x, "dtype") else x.dtype,
-        jnp.floating)
+    # array-likes only: Python scalars keep default promotion, matching the
+    # reference wrappers which cast tensors and leave scalars alone
+    return (hasattr(x, "dtype") and hasattr(x, "shape")
+            and jnp.issubdtype(x.dtype, jnp.floating))
 
 
 def _cast_tree_to(tree: Any, dtype: Any) -> Any:

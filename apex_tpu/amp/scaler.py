@@ -164,6 +164,11 @@ class StaticLossScale:
         return (type(self) is type(other)
                 and self.init_scale == other.init_scale)
 
+    def __hash__(self):
+        # keep hashability (the pre-refactor frozen dataclass had it): these
+        # objects are legitimate jit static args / cache keys
+        return hash((type(self), self.init_scale))
+
     def init(self) -> LossScaleState:
         return LossScaleState(
             loss_scale=jnp.asarray(self.init_scale, jnp.float32),

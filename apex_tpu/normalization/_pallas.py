@@ -39,7 +39,15 @@ def _block_rows(n_rows: int, hidden: int) -> int:
     rows = (min(n_rows, cap) // 8) * 8
     while rows >= 8 and n_rows % rows:
         rows -= 8
-    return rows if rows >= 8 else n_rows
+    if rows < 8:
+        # no feasible block under budget (cap < 8, or nothing divides
+        # n_rows): falling back to the whole array would blow the VMEM
+        # budget this function exists to enforce — refuse loudly instead
+        # (supports_pallas screens these shapes for the auto path)
+        raise ValueError(
+            f"no VMEM-feasible Pallas row block for rows={n_rows}, "
+            f"hidden={hidden}; pass use_pallas=False")
+    return rows
 
 
 def prefer_pallas(n_rows: int, hidden: int) -> bool:
@@ -61,9 +69,12 @@ def supports_pallas(n_rows: int, hidden: int) -> bool:
         return False
     if hidden % 128 or hidden * 4 * 5 > _VMEM_BUDGET:
         return False
-    # rows must tile by 8 or fit VMEM whole (see _block_rows)
+    # a feasible block must exist: the whole array under budget, or an
+    # 8-row-multiple tiling (which further requires >= 8 rows of budget —
+    # at hidden >~ 52k the 8-row block itself exceeds it, see _block_rows)
     per_row = hidden * 4 * 5
-    return n_rows % 8 == 0 or n_rows <= _VMEM_BUDGET // per_row
+    cap = _VMEM_BUDGET // per_row
+    return n_rows <= cap or (cap >= 8 and n_rows % 8 == 0)
 
 
 def _stats(xf: jnp.ndarray, eps: float, rms: bool):

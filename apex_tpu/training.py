@@ -1,0 +1,120 @@
+"""High-level train-step builders.
+
+The reference leaves loop assembly to users (NeMo/Megatron-style trainers);
+here the one genuinely intricate assembly — the hybrid TP x PP x DP GPT
+step with pipelined embedding + tied head — is packaged once and shared by
+``examples/gpt_pretrain.py`` and the driver dryrun (``__graft_entry__``),
+so the spec plumbing lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.config import TrainConfig
+from apex_tpu.optimizers import AdamState
+from apex_tpu.transformer.amp import GradScaler
+from apex_tpu.transformer.pipeline_parallel import (
+    forward_backward_pipelining_without_interleaving)
+from apex_tpu.utils.vma import cast_to_vma
+
+__all__ = ["GPTHybridTrainer"]
+
+
+class GPTHybridTrainer:
+    """Everything needed to train the flagship GPT over a
+    ``tp x pp x dp`` mesh from one :class:`~apex_tpu.config.TrainConfig`:
+
+        trainer = GPTHybridTrainer(cfg, mesh)
+        state = trainer.init_state(jax.random.PRNGKey(0))
+        step = jax.jit(trainer.train_step)
+        loss, *state = step(*state, tokens, targets)
+
+    ``tokens``/``targets``: ``(M, dp*mb, seq)`` int arrays (sharded over
+    ``data`` on axis 1). The step runs the pipelined schedule with the
+    vocab-parallel embedding on stage 0 and the tied head + loss on the
+    last stage, DP grad averaging, MP-synced dynamic loss scaling, and the
+    config's optimizer over (stage, shared) params.
+    """
+
+    def __init__(self, cfg: TrainConfig, mesh, init_scale: float = 2.0 ** 8):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.pp = cfg.parallel.pipeline_model_parallel_size
+        self.model = cfg.build_model()
+        self.opt = cfg.build_optimizer()
+        self.scaler = GradScaler(init_scale=init_scale)
+        _, self.split_params = self.model.stage_fn(self.pp)
+
+    # -- state ------------------------------------------------------------
+    def init_state(self, key: jax.Array) -> Tuple[Any, Any, Any, Any]:
+        params = self.model.init(key)
+        stage_stack = self.split_params(params)
+        shared = {"embedding": params["embedding"],
+                  "final_ln": params["final_ln"]}
+        opt_state = self.opt.init((stage_stack, shared))
+        return stage_stack, shared, opt_state, self.scaler.init()
+
+    # -- shardings --------------------------------------------------------
+    @staticmethod
+    def stage_specs(stage_stack) -> Any:
+        # per-layer TP stacks carry (pp, per, tp, ...); ln leaves don't
+        return jax.tree_util.tree_map(
+            lambda p: P("pipe", None, "tensor") if p.ndim >= 4
+            else P("pipe"), stage_stack)
+
+    shared_specs = {
+        "embedding": {"word": {"weight": P("tensor")}, "position": P()},
+        "final_ln": {"weight": P(), "bias": P()},
+    }
+
+    def state_specs(self, stage_stack):
+        specs_p = (self.stage_specs(stage_stack), self.shared_specs)
+        return (specs_p[0], specs_p[1],
+                AdamState(step=P(), exp_avg=specs_p, exp_avg_sq=specs_p),
+                P())
+
+    # -- the step ---------------------------------------------------------
+    def train_step(self, stage_stack, shared, opt_state, ls, tokens,
+                   targets):
+        model, opt, scaler, pp = self.model, self.opt, self.scaler, self.pp
+
+        def inner(stage_stack, shared, opt_state, ls, tokens, targets):
+            # rebuild the pipeline closures over THIS dp-rank's targets
+            stage, embed_fn, head_fn, _, _ = model.pipeline_fns(pp, targets)
+            # DDP pattern: params enter the differentiated region
+            # data-VARYING so AD yields per-replica grads, averaged
+            # explicitly below (pmean = the reference DDP allreduce)
+            vary = lambda t: jax.tree_util.tree_map(
+                lambda x: cast_to_vma(x, frozenset({"data"})), t)
+            my_stage = vary(jax.tree_util.tree_map(
+                lambda p: p[0], stage_stack))
+            loss, (sg, shg) = \
+                forward_backward_pipelining_without_interleaving(
+                    stage, tokens, my_stage, loss_fn=head_fn,
+                    shared_params=vary(shared), embed_fn=embed_fn,
+                    grad_scale=ls.loss_scale)
+            grads = (jax.tree_util.tree_map(lambda g: g[None], sg), shg)
+            grads = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, "data"), grads)
+            finite = scaler.all_finite_synced(grads)
+            new_ls = scaler.update(ls, finite)
+            new_p, new_s = opt.step(grads, opt_state,
+                                    (stage_stack, shared),
+                                    grads_finite=finite)
+            return (jax.lax.pmean(loss, "data"), new_p[0], new_p[1],
+                    new_s, new_ls)
+
+        sspec = self.stage_specs(stage_stack)
+        _, shspec, ospec, lspec = self.state_specs(stage_stack)
+        return shard_map(
+            inner, mesh=self.mesh,
+            in_specs=(sspec, shspec, ospec, lspec,
+                      P(None, "data"), P(None, "data")),
+            out_specs=(P(), sspec, shspec, ospec, lspec))(
+                stage_stack, shared, opt_state, ls, tokens, targets)

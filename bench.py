@@ -55,15 +55,12 @@ def _peak_flops() -> float:
     return 197e12  # assume v5e-class if unknown
 
 
-def _sync(out) -> float:
-    """Drain the device queue: fetch one element of one output leaf to the
-    host. On tunneled platforms ``jax.block_until_ready`` can return before
-    execution finishes (it tracks dispatch, not completion, across the
-    relay), so a value fetch is the only reliable fence."""
-    for leaf in jax.tree_util.tree_leaves(out):
-        if hasattr(leaf, "dtype"):
-            return float(np.asarray(jax.device_get(jnp.ravel(leaf)[0:1]))[0])
-    raise ValueError("no array leaf to sync on")
+def _sync(out) -> None:
+    """Drain the device queue (``jax.block_until_ready`` can return before
+    execution finishes across a tunneled dispatch path) — the shared fence
+    lives in :func:`apex_tpu.utils.timers.device_fence`."""
+    from apex_tpu.utils.timers import device_fence
+    device_fence(out)
 
 
 def _timeit(fn, args, iters, warmup, chunk=10):

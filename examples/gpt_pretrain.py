@@ -16,17 +16,11 @@ import argparse
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
-from jax.sharding import PartitionSpec as P
 
 from apex_tpu.config import (BatchConfig, ModelConfig, OptimizerConfig,
                              ParallelConfig, TrainConfig)
-from apex_tpu.optimizers import AdamState
+from apex_tpu.training import GPTHybridTrainer
 from apex_tpu.transformer import parallel_state
-from apex_tpu.transformer.amp import GradScaler
-from apex_tpu.transformer.pipeline_parallel import (
-    forward_backward_pipelining_without_interleaving)
-from apex_tpu.utils.vma import cast_to_vma
 
 
 def main(argv=None):
@@ -59,28 +53,10 @@ def main(argv=None):
         opt_level="O0")
 
     mesh = cfg.initialize_mesh()
-    model = cfg.build_model()
-    opt = cfg.build_optimizer()
-    scaler = GradScaler(init_scale=2.0 ** 8)
+    trainer = GPTHybridTrainer(cfg, mesh)
     calc = cfg.build_microbatch_calculator(dp)
     assert calc.get() == M
-
-    params = model.init(jax.random.PRNGKey(0))
-    _, split_params = model.stage_fn(pp)
-    stage_stack = split_params(params)
-    shared = {"embedding": params["embedding"],
-              "final_ln": params["final_ln"]}
-
-    def tp_leaf(leaf):
-        return P("pipe", None, "tensor") if leaf.ndim >= 4 else P("pipe")
-
-    stage_specs = jax.tree_util.tree_map(tp_leaf, stage_stack)
-    shared_specs = {
-        "embedding": {"word": {"weight": P("tensor")}, "position": P()},
-        "final_ln": {"weight": P(), "bias": P()},
-    }
-    opt_state = opt.init((stage_stack, shared))
-    ls = scaler.init()
+    state = list(trainer.init_state(jax.random.PRNGKey(0)))
 
     # Megatron sampler drives the host data order
     sampler = cfg.build_sampler(total_samples=10_000, consumed_samples=0,
@@ -90,38 +66,7 @@ def main(argv=None):
     data = rng.randint(0, args.vocab, (10_000, seq + 1))
     batches = iter(sampler)
 
-    def train_step(stage_stack, shared, opt_state, ls, tokens, targets):
-        def inner(stage_stack, shared, opt_state, ls, tokens, targets):
-            stage, embed_fn, head_fn, _, _ = model.pipeline_fns(pp, targets)
-            vary = lambda t: jax.tree_util.tree_map(
-                lambda x: cast_to_vma(x, frozenset({"data"})), t)
-            my_stage = vary(jax.tree_util.tree_map(
-                lambda p: p[0], stage_stack))
-            loss, (sg, shg) = \
-                forward_backward_pipelining_without_interleaving(
-                    stage, tokens, my_stage, loss_fn=head_fn,
-                    shared_params=vary(shared), embed_fn=embed_fn,
-                    grad_scale=ls.loss_scale)
-            grads = (jax.tree_util.tree_map(lambda g: g[None], sg), shg)
-            grads = jax.tree_util.tree_map(
-                lambda g: jax.lax.pmean(g, "data"), grads)
-            finite = scaler.all_finite_synced(grads)
-            new_ls = scaler.update(ls, finite)
-            new_p, new_s = opt.step(grads, opt_state, (stage_stack, shared),
-                                    grads_finite=finite)
-            return (jax.lax.pmean(loss, "data"), new_p[0], new_p[1], new_s,
-                    new_ls)
-
-        specs_p = (stage_specs, shared_specs)
-        specs_s = AdamState(step=P(), exp_avg=specs_p, exp_avg_sq=specs_p)
-        return shard_map(
-            inner, mesh=mesh,
-            in_specs=(stage_specs, shared_specs, specs_s, P(),
-                      P(None, "data"), P(None, "data")),
-            out_specs=(P(), stage_specs, shared_specs, specs_s, P()))(
-                stage_stack, shared, opt_state, ls, tokens, targets)
-
-    step_fn = jax.jit(train_step)
+    step_fn = jax.jit(trainer.train_step)
     loss = None
     try:
         for i in range(args.steps):
@@ -132,8 +77,8 @@ def main(argv=None):
             chunk = gather_rows(data, rows).reshape(M, dp * mb, seq + 1)
             tokens = jnp.asarray(chunk[..., :-1])
             targets = jnp.asarray(chunk[..., 1:])
-            loss, stage_stack, shared, opt_state, ls = step_fn(
-                stage_stack, shared, opt_state, ls, tokens, targets)
+            loss, *state = step_fn(*state, tokens, targets)
+            ls = state[-1]
             print(f"step {i}: loss {float(loss):.4f} "
                   f"scale {float(ls.loss_scale):.0f}")
     finally:

@@ -154,3 +154,26 @@ def test_jit_and_grad_through_module():
     g = jax.grad(loss)(params, x)
     assert g["weight"].shape == (128,)
     assert np.isfinite(np.asarray(g["weight"])).all()
+
+
+def test_pallas_block_sizing_respects_vmem_budget():
+    """Code-review r3: huge-hidden shapes where no 8-row block fits the
+    VMEM budget must be screened out of the auto path and refused loudly
+    on the explicit path — not silently compiled with a budget-busting
+    whole-array block."""
+    import unittest.mock as mock
+
+    from apex_tpu.normalization import _pallas
+
+    with mock.patch.object(_pallas.jax, "default_backend",
+                           return_value="tpu"):
+        # hidden=65536: per-row working set 1.25MB -> cap = 6 rows < 8
+        assert not _pallas.supports_pallas(1024, 65536)
+        # small row counts still fit whole
+        assert _pallas.supports_pallas(4, 65536)
+        # normal regime unchanged
+        assert _pallas.supports_pallas(8192, 4096)
+    with pytest.raises(ValueError):
+        _pallas._block_rows(1024, 65536)
+    assert _pallas._block_rows(4, 65536) == 4
+    assert _pallas._block_rows(8192, 4096) % 8 == 0
