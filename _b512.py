@@ -1,0 +1,45 @@
+import time, sys
+import jax, jax.numpy as jnp, numpy as np
+from apex_tpu.amp.scaler import DynamicLossScale, all_finite
+from apex_tpu.models import ResNet50, ResNetConfig
+from apex_tpu.optimizers import FlatOptimizer, FusedSGD
+from apex_tpu.utils.timers import device_fence
+
+def run(BATCH):
+    IMG = 224
+    cfg = ResNetConfig(num_classes=1000, compute_dtype=jnp.bfloat16)
+    model = ResNet50(cfg)
+    params, bn = model.init(jax.random.PRNGKey(0))
+    opt = FlatOptimizer(FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4))
+    opt_state = opt.init(params)
+    scaler = DynamicLossScale(init_scale=2.0**12)
+    ls = scaler.init()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(BATCH, IMG, IMG, 3), jnp.bfloat16)
+    labels = jnp.asarray(rng.randint(0, 1000, BATCH))
+    def loss_fn(params, bn_state, scale):
+        logits, new_bn = model(params, bn_state, x, training=True)
+        onehot = jax.nn.one_hot(labels, 1000)
+        loss = -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
+        return loss * scale, (loss, new_bn)
+    @(lambda f: jax.jit(f, donate_argnums=(0,1,2,3)))
+    def step(params, bn_state, opt_state, ls):
+        grads, (loss, new_bn) = jax.grad(loss_fn, has_aux=True)(params, bn_state, ls.loss_scale)
+        finite = all_finite(grads)
+        new_ls = scaler.update(ls, finite)
+        params, opt_state = opt.step(grads, opt_state, params, grads_finite=finite, scale=1.0/ls.loss_scale)
+        return params, new_bn, opt_state, new_ls
+    s = (params, bn, opt_state, ls)
+    for _ in range(5): s = step(*s)
+    device_fence(s)
+    t0=time.perf_counter(); device_fence(s); rtt=time.perf_counter()-t0
+    ts=[]
+    for _ in range(3):
+        t0=time.perf_counter()
+        for _ in range(15): s = step(*s)
+        device_fence(s)
+        ts.append((time.perf_counter()-t0-rtt)/15)
+    print(f"batch={BATCH}: {np.mean(ts)*1e3:.2f} ms/step  {BATCH/np.mean(ts):.1f} imgs/s")
+
+for b in [int(a) for a in sys.argv[1:]]:
+    run(b)

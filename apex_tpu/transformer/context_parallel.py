@@ -36,7 +36,8 @@ import jax.numpy as jnp
 from apex_tpu.ops.flash_attention import NEG_INF
 from apex_tpu.transformer.parallel_state import TENSOR_AXIS
 
-__all__ = ["ring_attention", "scatter_to_sequence_parallel_region",
+__all__ = ["ring_attention", "ulysses_attention",
+           "scatter_to_sequence_parallel_region",
            "gather_from_sequence_parallel_region",
            "reduce_scatter_to_sequence_parallel_region"]
 
@@ -114,6 +115,53 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     (m, l, acc, _, _), _ = jax.lax.scan(body, init, jnp.arange(cp))
     safe_l = jnp.where(l == 0.0, 1.0, l)
     return (acc / safe_l).astype(q.dtype)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      axis_name: str, causal: bool = False,
+                      softmax_scale: Optional[float] = None,
+                      attention_fn=None) -> jnp.ndarray:
+    """DeepSpeed-Ulysses context parallelism: two ``all_to_all``s instead
+    of a ring.
+
+    Input/output layout matches :func:`ring_attention` — ``(b, h, s/cp,
+    d)`` sequence shards. Internally the first ``all_to_all`` re-shards
+    from sequence-split to *head*-split (each device gets ``h/cp`` full-
+    sequence heads), runs ordinary full-sequence attention per local head
+    (``attention_fn``, default the fused flash/XLA dispatcher — so the
+    Pallas kernel runs on full sequences), and the second ``all_to_all``
+    restores sequence sharding. Requires ``h % cp == 0``; for more devices
+    than heads use :func:`ring_attention`. Ulysses moves O(b·s·d·h/cp) per
+    all_to_all but keeps the attention kernel monolithic; the ring keeps
+    traffic neighbor-to-neighbor but chunks the kernel — the standard
+    trade, both offered here.
+    """
+    b, h_loc_in, s_loc, d = q.shape
+    cp = jax.lax.axis_size(axis_name)
+    # note: h here is the LOCAL head count of the sequence-sharded layout,
+    # which equals the global head count (heads are replicated across cp)
+    if h_loc_in % cp:
+        raise ValueError(f"num heads {h_loc_in} not divisible by cp={cp}")
+    if attention_fn is None:
+        from apex_tpu.ops.flash_attention import flash_attention
+        attention_fn = functools.partial(flash_attention)
+
+    def seq_to_heads(x):
+        # (b, h, s/cp, d) -> (b, h/cp, s, d): each device keeps its head
+        # slice, receives the full sequence (tiled all_to_all splits axis 1
+        # by cp and concatenates received chunks along axis 2 in device —
+        # i.e. sequence — order)
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = attention_fn(qh, kh, vh, causal=causal,
+                       softmax_scale=softmax_scale)
+    return heads_to_seq(out)
 
 
 # ---------------------------------------------------------------------------
