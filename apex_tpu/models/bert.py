@@ -24,6 +24,7 @@ __all__ = ["BertConfig", "BertModel"]
 class BertConfig(GPTConfig):
     num_token_types: int = 2
     add_pooler: bool = True
+    add_binary_head: bool = True  # NSP/sentence-order head
 
 
 class BertModel(GPTModel):
@@ -44,6 +45,24 @@ class BertModel(GPTModel):
                     k2, (cfg.hidden_size, cfg.hidden_size))
                 ).astype(cfg.params_dtype),
                 "bias": jnp.zeros(cfg.hidden_size, cfg.params_dtype)}
+        # MLM head (standalone_bert.py BertLMHead:35-74): dense + LN +
+        # tied-embedding logits with a trainable output bias
+        k3, k4 = jax.random.split(jax.random.fold_in(key, 17), 2)
+        params["lm_head"] = {
+            "dense": {
+                "weight": (0.02 * jax.random.normal(
+                    k3, (cfg.hidden_size, cfg.hidden_size))
+                ).astype(cfg.params_dtype),
+                "bias": jnp.zeros(cfg.hidden_size, cfg.params_dtype)},
+            "ln": {"weight": jnp.ones(cfg.hidden_size, cfg.params_dtype),
+                   "bias": jnp.zeros(cfg.hidden_size, cfg.params_dtype)},
+            "bias": jnp.zeros(cfg.vocab_size, cfg.params_dtype),
+        }
+        if cfg.add_binary_head:
+            params["binary_head"] = {
+                "weight": (0.02 * jax.random.normal(
+                    k4, (2, cfg.hidden_size))).astype(cfg.params_dtype),
+                "bias": jnp.zeros(2, cfg.params_dtype)}
         return params
 
     def _attention(self, lp, x, bias=None, attn_seed=None):
@@ -122,8 +141,50 @@ class BertModel(GPTModel):
         b = params["pooler"]["bias"].astype(cls.dtype)
         return jnp.tanh(cls @ w.T + b)
 
+    def lm_logits(self, params: dict, h: jnp.ndarray) -> jnp.ndarray:
+        """MLM head (``standalone_bert.py`` ``BertLMHead:35-74``):
+        gelu(dense) -> LN -> tied-embedding logits + output bias."""
+        p = params["lm_head"]
+        w = p["dense"]["weight"].astype(h.dtype)
+        t = jax.nn.gelu(h @ w.T + p["dense"]["bias"].astype(h.dtype),
+                        approximate=True)
+        t = self._ln(p["ln"], t)
+        logits = self.logits(params, t)
+        return logits + p["bias"].astype(logits.dtype)
+
     def __call__(self, params, tokens, token_types=None, attention_mask=None,
                  dropout_rng=None):
         h = self.encode(params, tokens, token_types, attention_mask,
                         dropout_rng)
-        return self.logits(params, h)
+        return self.lm_logits(params, h)
+
+    def loss(self, params, tokens, lm_labels, loss_mask=None,
+             token_types=None, attention_mask=None, binary_labels=None,
+             dropout_rng=None):
+        """Pretraining loss (``standalone_bert.py``
+        ``post_language_model_processing:76-99``): masked-LM CE over the
+        ``loss_mask`` positions plus, when ``binary_labels`` is given and
+        the model has a binary head, the sentence-order CE on the pooled
+        [CLS]."""
+        from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+
+        h = self.encode(params, tokens, token_types, attention_mask,
+                        dropout_rng)
+        logits = self.lm_logits(params, h)
+        per_tok = softmax_cross_entropy_loss(
+            logits.reshape(-1, logits.shape[-1]), lm_labels.reshape(-1),
+            padding_idx=None, half_to_float=True).reshape(lm_labels.shape)
+        if loss_mask is not None:
+            lm_loss = jnp.sum(per_tok * loss_mask) / jnp.maximum(
+                jnp.sum(loss_mask), 1.0)
+        else:
+            lm_loss = jnp.mean(per_tok)
+        if binary_labels is None or "binary_head" not in params:
+            return lm_loss
+        pooled = self.pool(params, h)
+        bh = params["binary_head"]
+        blogits = (pooled @ bh["weight"].astype(pooled.dtype).T
+                   + bh["bias"].astype(pooled.dtype)).astype(jnp.float32)
+        bloss = jnp.mean(softmax_cross_entropy_loss(
+            blogits, binary_labels, padding_idx=None, half_to_float=True))
+        return lm_loss + bloss

@@ -207,3 +207,46 @@ def test_resnet50_forward_and_train_step():
     # batch-2 BN makes per-step loss noisy; the optimizer must still make
     # progress below the initial loss at some point
     assert min(losses[1:]) < losses[0]
+
+
+def test_bert_pretraining_loss_heads():
+    """MLM head + binary head (standalone_bert BertLMHead /
+    post_language_model_processing): masked-LM CE honors the loss mask,
+    the binary head adds its CE, and grads reach both heads and the tied
+    embedding."""
+    cfg = BertConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                     num_attention_heads=4, max_position_embeddings=32,
+                     compute_dtype=jnp.float32)
+    model = BertModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 128, (2, 32)))
+    labels = jnp.asarray(rng.randint(0, 128, (2, 32)))
+    mask = jnp.asarray((rng.rand(2, 32) < 0.15).astype(np.float32))
+    binary = jnp.asarray([0, 1])
+    types = jnp.asarray(rng.randint(0, 2, (2, 32)))
+    attn = jnp.ones((2, 32))
+
+    loss = model.loss(params, tokens, labels, loss_mask=mask,
+                      token_types=types, attention_mask=attn,
+                      binary_labels=binary)
+    assert np.isfinite(float(loss))
+    lm_only = model.loss(params, tokens, labels, loss_mask=mask,
+                         token_types=types, attention_mask=attn)
+    assert float(loss) > float(lm_only)  # binary CE adds
+
+    # loss mask: changing labels at masked-OUT positions changes nothing
+    labels2 = jnp.where(mask > 0, labels, (labels + 1) % 128)
+    np.testing.assert_allclose(
+        float(model.loss(params, tokens, labels2, loss_mask=mask,
+                         token_types=types, attention_mask=attn)),
+        float(lm_only), rtol=1e-6)
+
+    grads = jax.grad(lambda p: model.loss(
+        p, tokens, labels, loss_mask=mask, token_types=types,
+        attention_mask=attn, binary_labels=binary))(params)
+    for path in ("lm_head", "binary_head"):
+        assert any(float(np.abs(np.asarray(l)).max()) > 0
+                   for l in jax.tree_util.tree_leaves(grads[path]))
+    emb = np.asarray(grads["embedding"]["word"]["weight"])
+    assert np.abs(emb).max() > 0
