@@ -1,5 +1,6 @@
 """apex_tpu.utils — logging, timers, tree utilities, checkpointing."""
 
+from apex_tpu.utils.autoresume import AutoResume  # noqa: F401
 from apex_tpu.utils.timers import (  # noqa: F401
     Timer, Timers, device_fence, profile_trace)
 from apex_tpu.utils.logging import (  # noqa: F401

@@ -1,0 +1,79 @@
+"""Preemption-aware auto-resume.
+
+Reference: the ADLR cluster hook — ``get_autoresume``
+(``reference:apex/transformer/pipeline_parallel/utils.py:142-144``),
+``_set_adlr_autoresume`` (``testing/global_vars.py:156-172``), and the
+``--adlr-autoresume-interval`` termination polling in the arg namespace.
+The reference imports NVIDIA's external ``AutoResume`` module; here the
+same workflow is self-contained and TPU-shaped: Cloud TPU preemptions
+deliver SIGTERM with a grace window, so the request source is a signal
+handler (plus an optional env-var / callable hook for cluster schedulers),
+and the response is "checkpoint through :mod:`apex_tpu.checkpoint`, then
+request a clean exit; on restart, ``restore_checkpoint(latest)``".
+
+Usage::
+
+    ar = AutoResume(interval=50)          # poll every 50 steps
+    for step in range(start, total):
+        ...train...
+        if ar.termination_requested(step):
+            save_checkpoint(dir, state, step, host_state={"step": step})
+            ar.request_resume()           # exit(0) -> scheduler restarts
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+from typing import Callable, Optional
+
+__all__ = ["AutoResume"]
+
+
+class AutoResume:
+    """Termination detection + resume request.
+
+    ``hook``: optional callable returning True when the scheduler wants
+    the job to stop (the role of ADLR's ``AutoResume.termination_
+    requested``); the ``APEX_TPU_TERMINATE`` env var (any non-empty
+    value) and SIGTERM are always honored.
+    """
+
+    def __init__(self, interval: int = 1,
+                 hook: Optional[Callable[[], bool]] = None,
+                 install_sigterm_handler: bool = True):
+        if interval < 1:
+            raise ValueError("interval must be >= 1")
+        self.interval = interval
+        self.hook = hook
+        self._flag = threading.Event()
+        self._prev_handler = None
+        if install_sigterm_handler and threading.current_thread() is \
+                threading.main_thread():
+            self._prev_handler = signal.signal(
+                signal.SIGTERM, self._on_sigterm)
+
+    def _on_sigterm(self, signum, frame):
+        self._flag.set()
+        if callable(self._prev_handler):
+            self._prev_handler(signum, frame)
+
+    def termination_requested(self, step: Optional[int] = None) -> bool:
+        """True when the job should checkpoint and stop. With ``step``,
+        external hooks are only polled every ``interval`` steps (the
+        ``--adlr-autoresume-interval`` semantics); the SIGTERM flag is
+        always checked."""
+        if self._flag.is_set():
+            return True
+        if step is not None and step % self.interval:
+            return False
+        if os.environ.get("APEX_TPU_TERMINATE"):
+            return True
+        return bool(self.hook()) if self.hook is not None else False
+
+    def request_resume(self, exit_code: int = 0) -> None:
+        """Clean exit so the scheduler restarts the job (ADLR
+        ``request_resume``). Call after checkpointing."""
+        sys.exit(exit_code)

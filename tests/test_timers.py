@@ -92,3 +92,54 @@ def test_named_scopes_reach_hlo():
 
     txt = jax.jit(bn).lower(jnp.ones((4, 3))).as_text(debug_info=True)
     assert "sync_bn_stats" in txt
+
+
+class TestAutoResume:
+    def test_sigterm_sets_flag(self):
+        import os
+        import signal
+
+        from apex_tpu.utils.autoresume import AutoResume
+        ar = AutoResume(interval=10)
+        assert not ar.termination_requested(step=0)
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert ar.termination_requested(step=3)  # flag beats interval
+
+    def test_env_and_hook_polling(self, monkeypatch):
+        from apex_tpu.utils.autoresume import AutoResume
+        calls = []
+
+        def hook():
+            calls.append(1)
+            return False
+
+        ar = AutoResume(interval=5, hook=hook,
+                        install_sigterm_handler=False)
+        for s in range(1, 5):
+            assert not ar.termination_requested(step=s)
+        assert not calls  # off-interval steps do not poll
+        ar.termination_requested(step=5)
+        assert len(calls) == 1
+        monkeypatch.setenv("APEX_TPU_TERMINATE", "1")
+        assert ar.termination_requested(step=10)
+
+    def test_checkpoint_then_resume_flow(self, tmp_path, monkeypatch):
+        """The documented recipe: terminate -> checkpoint -> restart ->
+        restore latest."""
+        import jax.numpy as jnp
+        import pytest
+
+        from apex_tpu.checkpoint import restore_checkpoint, save_checkpoint
+        from apex_tpu.utils.autoresume import AutoResume
+
+        ar = AutoResume(install_sigterm_handler=False)
+        monkeypatch.setenv("APEX_TPU_TERMINATE", "1")
+        state = {"w": jnp.ones(4) * 7}
+        if ar.termination_requested(step=12):
+            save_checkpoint(str(tmp_path), state, step=12,
+                            host_state={"step": 12})
+            with pytest.raises(SystemExit):
+                ar.request_resume()
+        restored, host = restore_checkpoint(str(tmp_path), state)
+        assert host["step"] == 12
+        assert float(restored["w"][0]) == 7.0
