@@ -46,8 +46,11 @@ class BertModel(GPTModel):
                 ).astype(cfg.params_dtype),
                 "bias": jnp.zeros(cfg.hidden_size, cfg.params_dtype)}
         # MLM head (standalone_bert.py BertLMHead:35-74): dense + LN +
-        # tied-embedding logits with a trainable output bias
+        # tied-embedding logits with a trainable output bias. The output
+        # bias is stored vocab-sharded (tp, V/tp) like the tied embedding,
+        # so one P('tensor') spec covers it under TP.
         k3, k4 = jax.random.split(jax.random.fold_in(key, 17), 2)
+        tp = cfg.tensor_model_parallel_size
         params["lm_head"] = {
             "dense": {
                 "weight": (0.02 * jax.random.normal(
@@ -56,9 +59,11 @@ class BertModel(GPTModel):
                 "bias": jnp.zeros(cfg.hidden_size, cfg.params_dtype)},
             "ln": {"weight": jnp.ones(cfg.hidden_size, cfg.params_dtype),
                    "bias": jnp.zeros(cfg.hidden_size, cfg.params_dtype)},
-            "bias": jnp.zeros(cfg.vocab_size, cfg.params_dtype),
+            "bias": jnp.zeros((tp, cfg.vocab_size // tp),
+                              cfg.params_dtype),
         }
-        if cfg.add_binary_head:
+        # the binary head reads the pooled [CLS], so it requires the pooler
+        if cfg.add_binary_head and cfg.add_pooler:
             params["binary_head"] = {
                 "weight": (0.02 * jax.random.normal(
                     k4, (2, cfg.hidden_size))).astype(cfg.params_dtype),
@@ -144,13 +149,16 @@ class BertModel(GPTModel):
     def lm_logits(self, params: dict, h: jnp.ndarray) -> jnp.ndarray:
         """MLM head (``standalone_bert.py`` ``BertLMHead:35-74``):
         gelu(dense) -> LN -> tied-embedding logits + output bias."""
+        from apex_tpu.transformer.tensor_parallel.layers import _local_shard
+
         p = params["lm_head"]
         w = p["dense"]["weight"].astype(h.dtype)
         t = jax.nn.gelu(h @ w.T + p["dense"]["bias"].astype(h.dtype),
                         approximate=True)
         t = self._ln(p["ln"], t)
-        logits = self.logits(params, t)
-        return logits + p["bias"].astype(logits.dtype)
+        logits = self.logits(params, t)  # vocab-parallel shard when tp>1
+        bias = _local_shard(p["bias"], self.cfg.tensor_model_parallel_size)
+        return logits + bias.astype(logits.dtype)
 
     def __call__(self, params, tokens, token_types=None, attention_mask=None,
                  dropout_rng=None):
@@ -167,13 +175,19 @@ class BertModel(GPTModel):
         the model has a binary head, the sentence-order CE on the pooled
         [CLS]."""
         from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+        from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+            vocab_parallel_cross_entropy)
 
         h = self.encode(params, tokens, token_types, attention_mask,
                         dropout_rng)
         logits = self.lm_logits(params, h)
-        per_tok = softmax_cross_entropy_loss(
-            logits.reshape(-1, logits.shape[-1]), lm_labels.reshape(-1),
-            padding_idx=None, half_to_float=True).reshape(lm_labels.shape)
+        if self.cfg.tensor_model_parallel_size > 1:
+            per_tok = vocab_parallel_cross_entropy(logits, lm_labels)
+        else:
+            per_tok = softmax_cross_entropy_loss(
+                logits.reshape(-1, logits.shape[-1]), lm_labels.reshape(-1),
+                padding_idx=None, half_to_float=True
+            ).reshape(lm_labels.shape)
         if loss_mask is not None:
             lm_loss = jnp.sum(per_tok * loss_mask) / jnp.maximum(
                 jnp.sum(loss_mask), 1.0)

@@ -144,7 +144,7 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         raise ValueError(f"num heads {h_loc_in} not divisible by cp={cp}")
     if attention_fn is None:
         from apex_tpu.ops.flash_attention import flash_attention
-        attention_fn = functools.partial(flash_attention)
+        attention_fn = flash_attention
 
     def seq_to_heads(x):
         # (b, h, s/cp, d) -> (b, h/cp, s, d): each device keeps its head

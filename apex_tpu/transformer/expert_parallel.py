@@ -25,7 +25,7 @@ Design (single SPMD program, static shapes):
 from __future__ import annotations
 
 import math
-from typing import Any, NamedTuple, Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

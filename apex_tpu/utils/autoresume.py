@@ -73,6 +73,23 @@ class AutoResume:
             return True
         return bool(self.hook()) if self.hook is not None else False
 
+    def close(self) -> None:
+        """Restore the previous SIGTERM handler. Call (or use the instance
+        as a context manager) when the training run ends, so abandoned
+        instances do not permanently swallow SIGTERM or chain handlers."""
+        if self._prev_handler is not None:
+            try:
+                signal.signal(signal.SIGTERM, self._prev_handler)
+            except ValueError:  # not in main thread anymore
+                pass
+            self._prev_handler = None
+
+    def __enter__(self) -> "AutoResume":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
     def request_resume(self, exit_code: int = 0) -> None:
         """Clean exit so the scheduler restarts the job (ADLR
         ``request_resume``). Call after checkpointing."""

@@ -250,3 +250,55 @@ def test_bert_pretraining_loss_heads():
                    for l in jax.tree_util.tree_leaves(grads[path]))
     emb = np.asarray(grads["embedding"]["word"]["weight"])
     assert np.abs(emb).max() > 0
+
+
+def test_bert_mlm_head_under_tp2():
+    """Code-review r3: the MLM head must work under TP — vocab-sharded
+    output bias and vocab-parallel CE (the all-reduce falls out of
+    vocab_parallel_cross_entropy)."""
+    from apex_tpu.transformer import parallel_state
+
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size=2)
+    try:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        cfg = BertConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                         num_attention_heads=4, max_position_embeddings=16,
+                         compute_dtype=jnp.float32,
+                         tensor_model_parallel_size=2, use_flash=False,
+                         add_pooler=False, add_binary_head=True)
+        model = BertModel(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        assert "binary_head" not in params  # gated on the pooler
+        rng = np.random.RandomState(0)
+        tokens = jnp.asarray(rng.randint(0, 64, (2, 16)))
+        labels = jnp.asarray(rng.randint(0, 64, (2, 16)))
+        mask = jnp.ones((2, 16), jnp.float32)
+
+        specs = {
+            "embedding": {"word": {"weight": P("tensor")},
+                          "position": P(), "tokentype": P()},
+            "final_ln": {"weight": P(), "bias": P()},
+            "layers": jax.tree_util.tree_map(
+                lambda p: P(None, "tensor") if p.ndim >= 3 else P(),
+                params["layers"]),
+            "lm_head": {"dense": {"weight": P(), "bias": P()},
+                        "ln": {"weight": P(), "bias": P()},
+                        "bias": P("tensor")},
+        }
+
+        def run(params, tokens, labels, mask):
+            def inner(params, tokens, labels, mask):
+                return jax.lax.pmean(jax.lax.pmean(
+                    model.loss(params, tokens, labels, loss_mask=mask),
+                    "tensor"), "data")
+            return shard_map(inner, mesh=mesh,
+                             in_specs=(specs, P(), P(), P()),
+                             out_specs=P())(params, tokens, labels, mask)
+
+        loss = jax.jit(run)(params, tokens, labels, mask)
+        assert np.isfinite(float(loss))
+    finally:
+        parallel_state.destroy_model_parallel()

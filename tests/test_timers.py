@@ -100,10 +100,13 @@ class TestAutoResume:
         import signal
 
         from apex_tpu.utils.autoresume import AutoResume
-        ar = AutoResume(interval=10)
-        assert not ar.termination_requested(step=0)
-        os.kill(os.getpid(), signal.SIGTERM)
-        assert ar.termination_requested(step=3)  # flag beats interval
+        with AutoResume(interval=10) as ar:
+            assert not ar.termination_requested(step=0)
+            prev = signal.getsignal(signal.SIGTERM)
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert ar.termination_requested(step=3)  # flag beats interval
+        # context exit restored the previous handler
+        assert signal.getsignal(signal.SIGTERM) is not prev
 
     def test_env_and_hook_polling(self, monkeypatch):
         from apex_tpu.utils.autoresume import AutoResume
