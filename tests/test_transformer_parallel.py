@@ -602,3 +602,66 @@ def test_stage_predicates_with_explicit_virtual_rank():
         assert not_last.tolist() == [0, 0, 0, 0]
     finally:
         parallel_state.destroy_model_parallel()
+
+
+def test_gpt_interleaved_pipeline_with_embedding_head(mesh_pp4):
+    """Virtual-pipeline (vpp=2) GPT with the pipelined embedding + tied
+    head: Megatron chunk layout (chunk c on device d = global stage
+    c*S + d), loss and shared grads matching single-chip."""
+    from apex_tpu.models import GPTConfig, GPTModel
+
+    mesh = parallel_state.get_mesh()
+    S, VPP, M, mb, seq = 4, 2, 8, 2, 8
+    L = S * VPP  # one layer per global stage
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=L,
+                    num_attention_heads=4, max_position_embeddings=seq,
+                    compute_dtype=jnp.float32, use_flash=False)
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(3))
+    rng = np.random.RandomState(3)
+    tokens = jnp.asarray(rng.randint(0, 64, (M, mb, seq)))
+    targets = jnp.asarray(rng.randint(0, 64, (M, mb, seq)))
+
+    stage, embed_fn, head_fn, split_params, shared_of = model.pipeline_fns(
+        L, targets)
+    # (L, per=1, ...) -> (VPP, S, per, ...): axis 1 shards over pipe
+    chunked = jax.tree_util.tree_map(
+        lambda p: p.reshape(VPP, S, *p.shape[1:]), split_params(params))
+    shared = shared_of(params)
+
+    def run(chunked, shared):
+        def inner(chunked, shared):
+            mine = jax.tree_util.tree_map(lambda p: p[:, 0], chunked)
+            loss, (sg, shg) = forward_backward_pipelining_with_interleaving(
+                stage, tokens, mine, loss_fn=head_fn,
+                num_model_chunks=VPP, shared_params=shared,
+                embed_fn=embed_fn)
+            pm = lambda x: jax.lax.pmean(jax.lax.pmean(x, "data"), "tensor")
+            sg = jax.tree_util.tree_map(lambda g: pm(g)[:, None], sg)
+            return pm(loss), sg, jax.tree_util.tree_map(pm, shg)
+        spec = jax.tree_util.tree_map(lambda _: P(None, "pipe"), chunked)
+        shspec = jax.tree_util.tree_map(lambda _: P(), shared)
+        return shard_map(inner, mesh=mesh, in_specs=(spec, shspec),
+                         out_specs=(P(), spec, shspec))(chunked, shared)
+
+    loss_pipe, chunk_grads, shared_grads = jax.jit(run)(chunked, shared)
+
+    def ref_loss(params):
+        return jnp.mean(jax.vmap(
+            lambda tok, tgt: model.loss(params, tok, tgt))(tokens, targets))
+
+    loss_ref, grads_ref = jax.value_and_grad(ref_loss)(params)
+    np.testing.assert_allclose(float(loss_pipe), float(loss_ref), rtol=2e-5)
+
+    # chunk grads back to (L, ...) layer order: global stage g = c*S + d
+    for a, b in zip(jax.tree_util.tree_leaves(chunk_grads),
+                    jax.tree_util.tree_leaves(
+                        split_params(grads_ref))):
+        a = np.asarray(a)           # (VPP, S, per, ...)
+        a = a.reshape(L, *a.shape[2:])
+        np.testing.assert_allclose(a, np.asarray(b), rtol=5e-4, atol=5e-5)
+    for (ka, a), b in zip(
+            jax.tree_util.tree_leaves_with_path(shared_grads),
+            jax.tree_util.tree_leaves(shared_of(grads_ref))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5, err_msg=str(ka))
