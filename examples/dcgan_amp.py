@@ -1,0 +1,136 @@
+"""DCGAN mixed-precision training — ``reference:examples/dcgan/main_amp.py``
+rebuilt on apex_tpu.
+
+The reference example exists to show amp with MULTIPLE models and
+optimizers (``amp.initialize([netD, netG], [optD, optG], num_losses=3)``);
+the functional translation is simply: one policy, one loss-scale state and
+one optimizer state per network, three scaled backward passes per step
+(errD_real + errD_fake for D, errG for G). Synthetic data; tiny conv
+generator/discriminator (the architecture is not the point — the
+multi-loss amp wiring is).
+
+    python examples/dcgan_amp.py --steps 5
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.amp import all_finite, get_policy, make_loss_scale
+from apex_tpu.optimizers import FusedAdam
+
+IMG, NZ, CH = 16, 16, 8
+
+
+def _conv(x, w, stride):
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _deconv(x, w, stride):
+    return jax.lax.conv_transpose(
+        x, w.astype(x.dtype), (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def init_nets(key):
+    kd, kg = jax.random.split(key)
+    kd1, kd2, kd3 = jax.random.split(kd, 3)
+    kg1, kg2, kg3 = jax.random.split(kg, 3)
+    std = 0.05
+    netD = {
+        "c1": std * jax.random.normal(kd1, (4, 4, 3, CH)),
+        "c2": std * jax.random.normal(kd2, (4, 4, CH, 2 * CH)),
+        "fc": std * jax.random.normal(kd3, (2 * CH * (IMG // 4) ** 2, 1)),
+    }
+    netG = {
+        "fc": std * jax.random.normal(kg1, (NZ, 2 * CH * (IMG // 4) ** 2)),
+        "d1": std * jax.random.normal(kg2, (4, 4, 2 * CH, CH)),
+        "d2": std * jax.random.normal(kg3, (4, 4, CH, 3)),
+    }
+    return netD, netG
+
+
+def discriminate(p, x):
+    h = jax.nn.leaky_relu(_conv(x, p["c1"], 2), 0.2)
+    h = jax.nn.leaky_relu(_conv(h, p["c2"], 2), 0.2)
+    return (h.reshape(h.shape[0], -1) @ p["fc"].astype(h.dtype))[:, 0]
+
+
+def generate(p, z):
+    h = (z @ p["fc"].astype(z.dtype)).reshape(
+        z.shape[0], IMG // 4, IMG // 4, 2 * CH)
+    h = jax.nn.relu(_deconv(h, p["d1"], 2))
+    return jnp.tanh(_deconv(h, p["d2"], 2))
+
+
+def bce_logits(logits, target):
+    # stable binary cross entropy with logits, fp32
+    logits = logits.astype(jnp.float32)
+    return jnp.mean(jnp.maximum(logits, 0) - logits * target
+                    + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--opt-level", default="O1")
+    args = ap.parse_args(argv)
+
+    policy = get_policy(args.opt_level)
+    # one scaler per loss, as the reference's num_losses=3 (D keeps one:
+    # its two losses backward into the same grads)
+    scalers = [make_loss_scale(policy.loss_scale) for _ in range(2)]
+    lsD, lsG = (s.init() for s in scalers)
+
+    netD, netG = init_nets(jax.random.PRNGKey(0))
+    optD, optG = FusedAdam(lr=2e-4, betas=(0.5, 0.999)), \
+        FusedAdam(lr=2e-4, betas=(0.5, 0.999))
+    stateD, stateG = optD.init(netD), optG.init(netG)
+
+    rng = np.random.RandomState(0)
+    real = jnp.asarray(rng.randn(args.batch, IMG, IMG, 3),
+                       policy.compute_dtype)
+
+    @jax.jit
+    def train_step(netD, netG, stateD, stateG, lsD, lsG, z):
+        z = z.astype(policy.compute_dtype)
+
+        def lossD(pD):
+            fake = generate(netG, z)
+            errD = bce_logits(discriminate(pD, real), 1.0) + \
+                bce_logits(discriminate(pD, jax.lax.stop_gradient(fake)),
+                           0.0)
+            return scalers[0].scale(lsD, errD), errD
+
+        gD, errD = jax.grad(lossD, has_aux=True)(netD)
+        gD = scalers[0].unscale(lsD, gD)
+        finD = all_finite(gD)
+        netD2, stateD = optD.step(gD, stateD, netD, grads_finite=finD)
+
+        def lossG(pG):
+            errG = bce_logits(discriminate(netD2, generate(pG, z)), 1.0)
+            return scalers[1].scale(lsG, errG), errG
+
+        gG, errG = jax.grad(lossG, has_aux=True)(netG)
+        gG = scalers[1].unscale(lsG, gG)
+        finG = all_finite(gG)
+        netG2, stateG = optG.step(gG, stateG, netG, grads_finite=finG)
+        return (netD2, netG2, stateD, stateG,
+                scalers[0].update(lsD, finD), scalers[1].update(lsG, finG),
+                errD, errG)
+
+    for i in range(args.steps):
+        z = jnp.asarray(np.random.RandomState(i).randn(args.batch, NZ))
+        (netD, netG, stateD, stateG, lsD, lsG, errD, errG) = train_step(
+            netD, netG, stateD, stateG, lsD, lsG, z)
+        print(f"step {i}: errD {float(errD):.4f} errG {float(errG):.4f}")
+    return float(errD), float(errG)
+
+
+if __name__ == "__main__":
+    main()

@@ -3,6 +3,8 @@ end to end on the CPU mesh."""
 
 import sys
 
+import numpy as np
+
 import pytest
 
 sys.path.insert(0, "examples")
@@ -30,3 +32,9 @@ def test_gpt_pretrain_runs():
     import gpt_pretrain
     loss = gpt_pretrain.main(["--tp", "2", "--pp", "2", "--steps", "2"])
     assert loss > 0
+
+
+def test_dcgan_amp_runs():
+    import dcgan_amp
+    errD, errG = dcgan_amp.main(["--steps", "3", "--batch", "8"])
+    assert np.isfinite(errD) and np.isfinite(errG)
