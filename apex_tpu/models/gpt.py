@@ -60,6 +60,10 @@ class GPTConfig:
     layernorm_epsilon: float = 1e-5
     remat: bool = False          # per-layer activation checkpointing
     use_flash: Optional[bool] = None  # None = auto by shape/backend
+    # Megatron-LM sequence parallelism: norms/dropout/residuals run on
+    # (b, s/tp, h) sequence shards; ColumnParallel inputs all-gather the
+    # sequence, RowParallel outputs reduce-scatter back to shards
+    sequence_parallel: bool = False
     # Dropout (standalone_gpt.py attention/hidden dropout; 0.0 = off so
     # eval-style calls stay deterministic without threading an rng).
     # Semantics under TP follow the reference's RNG stream layout
@@ -99,18 +103,25 @@ class GPTModel:
         self.embedding = VocabParallelEmbedding(
             cfg.vocab_size, cfg.hidden_size, init_method=init,
             params_dtype=cfg.params_dtype, world_size=tp)
+        sp = cfg.sequence_parallel and tp > 1
+        if cfg.sequence_parallel and tp <= 1:
+            raise ValueError("sequence_parallel requires tp > 1")
         self.qkv = ColumnParallelLinear(
             cfg.hidden_size, 3 * cfg.hidden_size, gather_output=False,
-            init_method=init, params_dtype=cfg.params_dtype, world_size=tp)
+            init_method=init, params_dtype=cfg.params_dtype, world_size=tp,
+            sequence_parallel=sp, seq_axis=1)
         self.proj = RowParallelLinear(
             cfg.hidden_size, cfg.hidden_size, input_is_parallel=True,
-            init_method=out_init, params_dtype=cfg.params_dtype, world_size=tp)
+            init_method=out_init, params_dtype=cfg.params_dtype,
+            world_size=tp, sequence_parallel=sp, seq_axis=1)
         self.fc1 = ColumnParallelLinear(
             cfg.hidden_size, cfg.ffn, gather_output=False, init_method=init,
-            params_dtype=cfg.params_dtype, world_size=tp)
+            params_dtype=cfg.params_dtype, world_size=tp,
+            sequence_parallel=sp, seq_axis=1)
         self.fc2 = RowParallelLinear(
             cfg.ffn, cfg.hidden_size, input_is_parallel=True,
-            init_method=out_init, params_dtype=cfg.params_dtype, world_size=tp)
+            init_method=out_init, params_dtype=cfg.params_dtype,
+            world_size=tp, sequence_parallel=sp, seq_axis=1)
 
     # -- params -------------------------------------------------------------
 
@@ -158,9 +169,11 @@ class GPTModel:
     def _attention(self, lp: dict, x: jnp.ndarray,
                    attn_seed=None) -> jnp.ndarray:
         cfg = self.cfg
-        b, s, _ = x.shape
+        b = x.shape[0]
         local_heads = cfg.num_attention_heads // cfg.tensor_model_parallel_size
-        qkv, _ = self.qkv(lp["qkv"], x)  # (b, s, 3*h/tp)
+        qkv, _ = self.qkv(lp["qkv"], x)  # (b, s_full, 3*h/tp) — under SP
+        # the ColumnParallel input gather restores the full sequence here
+        s = qkv.shape[1]
         qkv = qkv.reshape(b, s, local_heads, 3 * cfg.head_dim)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = jnp.transpose(q, (0, 2, 1, 3))  # (b, nh, s, d)
@@ -202,9 +215,15 @@ class GPTModel:
             attn_key = jax.random.fold_in(
                 attn_key, jax.lax.axis_index(TENSOR_AXIS) + 1)
         seeds = jax.random.randint(
-            jax.random.fold_in(attn_key, 1), (cfg.num_layers,), 0, 1 << 24)
-        hkeys = jax.random.split(jax.random.fold_in(dropout_rng, 2),
-                                 2 * cfg.num_layers)
+            jax.random.fold_in(attn_key, 1), (cfg.num_layers,), 0,
+            2 ** 31 - 1)
+        hidden_key = jax.random.fold_in(dropout_rng, 2)
+        if cfg.sequence_parallel:
+            # SP: hidden dropout acts on per-rank sequence shards, so each
+            # rank needs an independent stream (Megatron SP RNG semantics)
+            hidden_key = jax.random.fold_in(
+                hidden_key, jax.lax.axis_index(TENSOR_AXIS) + 1)
+        hkeys = jax.random.split(hidden_key, 2 * cfg.num_layers)
         hkeys = hkeys.reshape(cfg.num_layers, 2, *hkeys.shape[1:])
         return {"attn_seed": seeds, "h1": hkeys[:, 0], "h2": hkeys[:, 1]}
 
@@ -216,10 +235,20 @@ class GPTModel:
         h = self.embedding(params["embedding"]["word"], tokens)
         pos = params["embedding"]["position"][: tokens.shape[1]]
         h = (h + pos).astype(cfg.compute_dtype)
+        if cfg.sequence_parallel:
+            from apex_tpu.transformer.context_parallel import (
+                scatter_to_sequence_parallel_region)
+            h = scatter_to_sequence_parallel_region(h, TENSOR_AXIS,
+                                                    seq_axis=1)
         if dropout_rng is not None:
-            # embedding dropout at the hidden rate (standalone_gpt Embedding)
-            h = dropout(h, cfg.hidden_dropout,
-                        jax.random.fold_in(dropout_rng, 3))
+            # embedding dropout at the hidden rate (standalone_gpt
+            # Embedding); under SP the rate applies to this rank's shard
+            # with a rank-folded key (Megatron's SP RNG stream)
+            key = jax.random.fold_in(dropout_rng, 3)
+            if cfg.sequence_parallel:
+                key = jax.random.fold_in(
+                    key, jax.lax.axis_index(TENSOR_AXIS) + 1)
+            h = dropout(h, cfg.hidden_dropout, key)
         return h
 
     def transform(self, params: dict, x: jnp.ndarray,
@@ -246,7 +275,14 @@ class GPTModel:
                 return layer_fn(lp, x), None
 
         x, _ = scan_stable_vma(body, x, xs)
-        return self._ln(params["final_ln"], x)
+        x = self._ln(params["final_ln"], x)
+        if cfg.sequence_parallel:
+            from apex_tpu.transformer.context_parallel import (
+                gather_from_sequence_parallel_region)
+            x = gather_from_sequence_parallel_region(x, TENSOR_AXIS,
+                                                     seq_axis=1,
+                                                     invariant=True)
+        return x
 
     def logits(self, params: dict, x: jnp.ndarray) -> jnp.ndarray:
         """Tied output embedding (standalone_gpt.py parallel_lm_logits):
@@ -280,6 +316,29 @@ class GPTModel:
             return jnp.sum(per_tok * loss_mask) / jnp.maximum(
                 jnp.sum(loss_mask), 1.0)
         return jnp.mean(per_tok)
+
+    def sp_grad_sync(self, grads: dict) -> dict:
+        """Sum LayerNorm grads over the tensor axis under sequence
+        parallelism. SP computes norms on sequence shards, so their param
+        grads emerge as per-rank partials — Megatron-LM marks those params
+        ``sequence_parallel`` and allreduces them separately
+        (Megatron-LM ``allreduce_sequence_parallel_grad``); this is that
+        allreduce. No-op when SP is off. Call on the grads before the
+        optimizer step (other grads are already replicated/TP-reduced)."""
+        if not self.cfg.sequence_parallel:
+            return grads
+
+        def ps(t):
+            return jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, TENSOR_AXIS), t)
+
+        out = dict(grads)
+        out["final_ln"] = ps(grads["final_ln"])
+        layers = dict(grads["layers"])
+        layers["ln1"] = ps(layers["ln1"])
+        layers["ln2"] = ps(layers["ln2"])
+        out["layers"] = layers
+        return out
 
     # -- pipeline integration ----------------------------------------------
 

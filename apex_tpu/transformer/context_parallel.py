@@ -168,39 +168,60 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 # Megatron-LM sequence parallelism (norms/dropout on sequence shards)
 # ---------------------------------------------------------------------------
 
-def _seq_axis(x: jnp.ndarray) -> int:
-    # (seq, ...) layout: Megatron-LM SP shards the leading sequence dim
-    return 0
-
-
 def scatter_to_sequence_parallel_region(x: jnp.ndarray,
-                                        axis_name: str = TENSOR_AXIS
-                                        ) -> jnp.ndarray:
+                                        axis_name: str = TENSOR_AXIS,
+                                        seq_axis: int = 0) -> jnp.ndarray:
     """Split the sequence dim across the TP axis (fwd); gather in bwd.
-    Entering an SP region (Megatron-LM ``scatter_to_sequence_parallel``)."""
+    Entering an SP region (Megatron-LM ``scatter_to_sequence_parallel``;
+    the reference layout is (s, b, h) so ``seq_axis`` defaults to 0 —
+    pass 1 for (b, s, h) models)."""
     tp = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
-    ax = _seq_axis(x)
-    if x.shape[ax] % tp:
-        raise ValueError(f"sequence dim {x.shape[ax]} not divisible by "
-                         f"tp={tp}")
-    chunk = x.shape[ax] // tp
-    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=ax)
+    if x.shape[seq_axis] % tp:
+        raise ValueError(f"sequence dim {x.shape[seq_axis]} not divisible "
+                         f"by tp={tp}")
+    chunk = x.shape[seq_axis] // tp
+    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk,
+                                        axis=seq_axis)
 
 
 def gather_from_sequence_parallel_region(x: jnp.ndarray,
-                                         axis_name: str = TENSOR_AXIS
+                                         axis_name: str = TENSOR_AXIS,
+                                         seq_axis: int = 0,
+                                         invariant: bool = False
                                          ) -> jnp.ndarray:
-    """all_gather the sequence shards (fwd); split in bwd. Leaving an SP
-    region into a TP matmul."""
-    return jax.lax.all_gather(x, axis_name, axis=_seq_axis(x), tiled=True)
+    """all_gather the sequence shards (fwd); reduce-scatter in bwd. Leaving
+    an SP region into a TP matmul.
+
+    ``invariant=True`` types the gathered result device-invariant (every
+    rank provably holds the same full sequence). Inside a TP model this
+    matters for AD bookkeeping: plain-TP activations are invariant, so the
+    SP gather must restore that type or replicated-parameter cotangents
+    get attributed per-rank and differ from the TP=1 semantics (see
+    tests/test_models.py::test_gpt_sequence_parallel_matches_tp)."""
+    if not invariant:
+        return jax.lax.all_gather(x, axis_name, axis=seq_axis, tiled=True)
+    try:
+        from jax._src.lax.parallel import all_gather_invariant
+        return all_gather_invariant(x, axis_name, axis=seq_axis, tiled=True)
+    except ImportError:  # pragma: no cover - private symbol moved
+        tp = jax.lax.axis_size(axis_name)
+        rank = jax.lax.axis_index(axis_name)
+        full = list(x.shape)
+        full[seq_axis] *= tp
+        return jax.lax.psum(
+            jax.lax.dynamic_update_slice_in_dim(
+                jnp.zeros(full, x.dtype), x, rank * x.shape[seq_axis],
+                axis=seq_axis),
+            axis_name)
 
 
 def reduce_scatter_to_sequence_parallel_region(x: jnp.ndarray,
-                                               axis_name: str = TENSOR_AXIS
+                                               axis_name: str = TENSOR_AXIS,
+                                               seq_axis: int = 0
                                                ) -> jnp.ndarray:
     """psum_scatter along the sequence dim — the RowParallel output path
     under SP (replaces the plain psum: each rank keeps only its sequence
     shard of the reduced activations)."""
-    return jax.lax.psum_scatter(x, axis_name,
-                                scatter_dimension=_seq_axis(x), tiled=True)
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=seq_axis,
+                                tiled=True)

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.parallel_state import TENSOR_AXIS
 from apex_tpu.transformer.tensor_parallel.mappings import (
+    _vary,
     copy_to_tensor_model_parallel_region,
     gather_from_tensor_model_parallel_region,
     reduce_from_tensor_model_parallel_region,
@@ -119,7 +120,8 @@ class ColumnParallelLinear:
                  skip_bias_add: bool = False, params_dtype=jnp.float32,
                  world_size: Optional[int] = None,
                  no_async_tensor_model_parallel_allreduce: bool = False,
-                 gradient_accumulation_fusion: bool = False):
+                 gradient_accumulation_fusion: bool = False,
+                 sequence_parallel: bool = False, seq_axis: int = 1):
         self.input_size = input_size
         self.output_size = output_size
         self.use_bias = bias
@@ -130,6 +132,11 @@ class ColumnParallelLinear:
         self.world_size = (world_size if world_size is not None
                            else _default_tp_world_size())
         self.output_size_per_partition = divide(output_size, self.world_size)
+        # Megatron-LM sequence parallelism: the input arrives as a sequence
+        # shard; forward all-gathers it (AD transpose = reduce-scatter of
+        # the input cotangents, the SP backward)
+        self.sequence_parallel = sequence_parallel
+        self.seq_axis = seq_axis
 
     def init(self, key: jax.Array) -> dict:
         # master weight then split along out dim (:56-151)
@@ -147,7 +154,13 @@ class ColumnParallelLinear:
                  ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
         w = _local_shard(params["weight"], self.world_size)
         if self.world_size > 1:
-            x = copy_to_tensor_model_parallel_region(x)
+            if self.sequence_parallel:
+                from apex_tpu.transformer.context_parallel import (
+                    gather_from_sequence_parallel_region)
+                x = gather_from_sequence_parallel_region(
+                    x, TENSOR_AXIS, self.seq_axis, invariant=True)
+            else:
+                x = copy_to_tensor_model_parallel_region(x)
         out = _dense(x, w).astype(x.dtype)
         b = None
         if self.use_bias:
@@ -170,7 +183,8 @@ class RowParallelLinear:
                  input_is_parallel: bool = False,
                  init_method: Optional[Callable] = None,
                  skip_bias_add: bool = False, params_dtype=jnp.float32,
-                 world_size: Optional[int] = None):
+                 world_size: Optional[int] = None,
+                 sequence_parallel: bool = False, seq_axis: int = 1):
         self.input_size = input_size
         self.output_size = output_size
         self.use_bias = bias
@@ -181,6 +195,8 @@ class RowParallelLinear:
         self.world_size = (world_size if world_size is not None
                            else _default_tp_world_size())
         self.input_size_per_partition = divide(input_size, self.world_size)
+        self.sequence_parallel = sequence_parallel
+        self.seq_axis = seq_axis
 
     def init(self, key: jax.Array) -> dict:
         master = self.init_method(key, (self.output_size, self.input_size))
@@ -216,8 +232,18 @@ class RowParallelLinear:
             b_fold = _scale_grad(b.astype(jnp.float32), self.world_size)
             partial = partial + (b_fold / self.world_size).astype(partial.dtype)
             b = None
-        out = (reduce_from_tensor_model_parallel_region(partial)
-               if self.world_size > 1 else partial)
+        if self.world_size > 1:
+            if self.sequence_parallel:
+                # SP: the reduction scatters — each rank keeps its sequence
+                # shard of the reduced activations (Megatron-LM SP RowParallel)
+                from apex_tpu.transformer.context_parallel import (
+                    reduce_scatter_to_sequence_parallel_region)
+                out = reduce_scatter_to_sequence_parallel_region(
+                    _vary(partial), TENSOR_AXIS, self.seq_axis)
+            else:
+                out = reduce_from_tensor_model_parallel_region(partial)
+        else:
+            out = partial
         return out, b
 
 
