@@ -13,6 +13,11 @@ from apex_tpu.ops.focal_loss import FocalLoss, focal_loss  # noqa: F401
 from apex_tpu.ops.fused_softmax import (  # noqa: F401
     AttnMaskType, FusedScaleMaskSoftmax, scaled_masked_softmax,
     scaled_upper_triang_masked_softmax)
+from apex_tpu.ops.conv_fusion import (  # noqa: F401
+    conv_bias, conv_bias_mask_relu, conv_bias_relu,
+    conv_frozen_scale_bias_relu)
+from apex_tpu.ops.multihead_attn import (  # noqa: F401
+    EncdecMultiheadAttn, SelfMultiheadAttn)
 from apex_tpu.ops.transducer import (  # noqa: F401
     TransducerJoint, TransducerLoss, transducer_joint, transducer_loss)
 from apex_tpu.ops.mlp import (  # noqa: F401
@@ -31,4 +36,7 @@ __all__ = [
     "SoftmaxCrossEntropyLoss", "softmax_cross_entropy_loss",
     "TransducerJoint", "TransducerLoss", "transducer_joint",
     "transducer_loss",
+    "SelfMultiheadAttn", "EncdecMultiheadAttn",
+    "conv_bias", "conv_bias_relu", "conv_bias_mask_relu",
+    "conv_frozen_scale_bias_relu",
 ]

@@ -1,0 +1,60 @@
+"""Fused conv + bias (+ ReLU / mask / frozen scale-bias) ops.
+
+Reference: ``reference:apex/contrib/conv_bias_relu/`` over the
+cudnn-frontend graph extension (``apex/contrib/csrc/conv_bias_relu/``,
+1,639 LoC): ``ConvBiasReLU``, ``ConvBias``, ``ConvBiasMaskReLU``,
+``ConvFrozenScaleBiasReLU``.
+
+On TPU these are *definitionally* fused — XLA folds bias/scale/ReLU/mask
+elementwise epilogues into the convolution's output fusion — so each
+function below is the semantic spec (NHWC, torch-compatible padding/stride)
+and the fusion is the compiler's. They exist as named entry points for API
+parity and so the parity tests pin the numerics against torch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["conv_bias", "conv_bias_relu", "conv_bias_mask_relu",
+           "conv_frozen_scale_bias_relu"]
+
+
+def _conv2d_nhwc(x, w, stride, padding):
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride),
+        [(padding, padding), (padding, padding)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def conv_bias(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray,
+              stride: int = 1, padding: int = 0) -> jnp.ndarray:
+    """``ConvBias``: NHWC conv + per-channel bias."""
+    return _conv2d_nhwc(x, weight, stride, padding) + bias.astype(x.dtype)
+
+
+def conv_bias_relu(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray,
+                   stride: int = 1, padding: int = 0) -> jnp.ndarray:
+    """``ConvBiasReLU``: conv + bias + ReLU in one fusion."""
+    return jax.nn.relu(conv_bias(x, weight, bias, stride, padding))
+
+
+def conv_bias_mask_relu(x: jnp.ndarray, weight: jnp.ndarray,
+                        bias: jnp.ndarray, mask: jnp.ndarray,
+                        stride: int = 1, padding: int = 0) -> jnp.ndarray:
+    """``ConvBiasMaskReLU``: conv + bias, elementwise mask, then ReLU."""
+    return jax.nn.relu(conv_bias(x, weight, bias, stride, padding)
+                       * mask.astype(x.dtype))
+
+
+def conv_frozen_scale_bias_relu(x: jnp.ndarray, weight: jnp.ndarray,
+                                scale: jnp.ndarray, bias: jnp.ndarray,
+                                stride: int = 1, padding: int = 0
+                                ) -> jnp.ndarray:
+    """``ConvFrozenScaleBiasReLU``: conv, then frozen-BN affine (per-channel
+    scale + bias), then ReLU — inference-mode folded batchnorm."""
+    out = _conv2d_nhwc(x, weight, stride, padding)
+    return jax.nn.relu(out * scale.astype(x.dtype) + bias.astype(x.dtype))

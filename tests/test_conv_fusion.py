@@ -1,0 +1,70 @@
+"""conv+bias(+relu/mask/scale) parity vs torch
+(``reference:apex/contrib/test/conv_bias_relu/test_conv_bias_relu.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+from apex_tpu.ops.conv_fusion import (conv_bias, conv_bias_mask_relu,
+                                      conv_bias_relu,
+                                      conv_frozen_scale_bias_relu)
+
+
+def _data(cin=4, cout=8, k=3, n=2, s=10, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, s, s, cin).astype(np.float32)
+    w = rng.randn(k, k, cin, cout).astype(np.float32) * 0.1
+    b = rng.randn(cout).astype(np.float32)
+    return x, w, b
+
+
+def _torch_conv(x, w, b, stride, padding):
+    tx = torch.tensor(x).permute(0, 3, 1, 2)
+    tw = torch.tensor(w).permute(3, 2, 0, 1)
+    out = F.conv2d(tx, tw, torch.tensor(b), stride=stride, padding=padding)
+    return out.permute(0, 2, 3, 1).numpy()
+
+
+def test_conv_bias_and_relu_match_torch():
+    x, w, b = _data()
+    for stride, pad in [(1, 1), (2, 0)]:
+        ref = _torch_conv(x, w, b, stride, pad)
+        np.testing.assert_allclose(
+            np.asarray(conv_bias(jnp.asarray(x), jnp.asarray(w),
+                                 jnp.asarray(b), stride, pad)),
+            ref, rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(
+            np.asarray(conv_bias_relu(jnp.asarray(x), jnp.asarray(w),
+                                      jnp.asarray(b), stride, pad)),
+            np.maximum(ref, 0), rtol=2e-5, atol=2e-5)
+
+
+def test_conv_bias_mask_relu_and_frozen_scale():
+    x, w, b = _data(seed=1)
+    ref = _torch_conv(x, w, b, 1, 1)
+    mask = (np.random.RandomState(2).rand(*ref.shape) > 0.5).astype(
+        np.float32)
+    np.testing.assert_allclose(
+        np.asarray(conv_bias_mask_relu(jnp.asarray(x), jnp.asarray(w),
+                                       jnp.asarray(b), jnp.asarray(mask),
+                                       1, 1)),
+        np.maximum(ref * mask, 0), rtol=2e-5, atol=2e-5)
+
+    scale = np.random.RandomState(3).rand(8).astype(np.float32) + 0.5
+    ref_nb = _torch_conv(x, w, np.zeros(8, np.float32), 1, 1)
+    np.testing.assert_allclose(
+        np.asarray(conv_frozen_scale_bias_relu(
+            jnp.asarray(x), jnp.asarray(w), jnp.asarray(scale),
+            jnp.asarray(b), 1, 1)),
+        np.maximum(ref_nb * scale + b, 0), rtol=2e-5, atol=2e-5)
+
+
+def test_grads_flow():
+    x, w, b = _data(seed=4)
+    g = jax.grad(lambda w, b: jnp.sum(conv_bias_relu(
+        jnp.asarray(x), w, b, 1, 1) ** 2), argnums=(0, 1))(
+            jnp.asarray(w), jnp.asarray(b))
+    for leaf in g:
+        assert np.isfinite(np.asarray(leaf)).all()

@@ -1,0 +1,114 @@
+"""MHA module parity vs torch.nn.MultiheadAttention
+(``reference:apex/contrib/test/multihead_attn/test_*`` role: fast impl vs
+the default framework impl)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu.ops.multihead_attn import EncdecMultiheadAttn, SelfMultiheadAttn
+
+T, B, H, NH = 12, 3, 32, 4
+
+
+def _torch_mha(embed_dim, heads):
+    m = torch.nn.MultiheadAttention(embed_dim, heads, bias=False)
+    m.eval()
+    return m
+
+
+def test_self_attn_matches_torch():
+    attn = SelfMultiheadAttn(H, NH, bias=False)
+    params = attn.init(jax.random.PRNGKey(0))
+    tm = _torch_mha(H, NH)
+    with torch.no_grad():
+        tm.in_proj_weight.copy_(torch.tensor(
+            np.asarray(params["qkv"]["weight"])))
+        tm.out_proj.weight.copy_(torch.tensor(
+            np.asarray(params["out"]["weight"])))
+
+    x = np.random.RandomState(1).randn(T, B, H).astype(np.float32)
+    out = attn(params, jnp.asarray(x))
+    tout, _ = tm(torch.tensor(x), torch.tensor(x), torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(out), tout.detach().numpy(),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_self_attn_padding_and_causal_match_torch():
+    attn = SelfMultiheadAttn(H, NH, bias=False)
+    params = attn.init(jax.random.PRNGKey(2))
+    tm = _torch_mha(H, NH)
+    with torch.no_grad():
+        tm.in_proj_weight.copy_(torch.tensor(
+            np.asarray(params["qkv"]["weight"])))
+        tm.out_proj.weight.copy_(torch.tensor(
+            np.asarray(params["out"]["weight"])))
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(T, B, H).astype(np.float32)
+    pad = np.zeros((B, T), bool)
+    pad[:, -3:] = True
+
+    out = attn(params, jnp.asarray(x),
+               key_padding_mask=jnp.asarray(pad))
+    tout, _ = tm(torch.tensor(x), torch.tensor(x), torch.tensor(x),
+                 key_padding_mask=torch.tensor(pad))
+    np.testing.assert_allclose(np.asarray(out), tout.detach().numpy(),
+                               rtol=2e-4, atol=2e-4)
+
+    causal = torch.triu(torch.ones(T, T, dtype=torch.bool), diagonal=1)
+    out_c = attn(params, jnp.asarray(x), attn_mask_causal=True)
+    tout_c, _ = tm(torch.tensor(x), torch.tensor(x), torch.tensor(x),
+                   attn_mask=causal)
+    np.testing.assert_allclose(np.asarray(out_c), tout_c.detach().numpy(),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_self_attn_norm_add_and_grads():
+    attn = SelfMultiheadAttn(H, NH, bias=True, include_norm_add=True)
+    params = attn.init(jax.random.PRNGKey(4))
+    x = jnp.asarray(np.random.RandomState(5).randn(T, B, H), jnp.float32)
+    out = attn(params, x)
+    assert out.shape == x.shape
+    # norm-add is residual + attn(LN(x)): zeroing the out-proj leaves x
+    zeroed = jax.tree_util.tree_map(jnp.zeros_like, params["out"])
+    p2 = dict(params, out=zeroed)
+    np.testing.assert_allclose(np.asarray(attn(p2, x)), np.asarray(x),
+                               rtol=1e-6)
+    g = jax.grad(lambda p: jnp.sum(attn(p, x) ** 2))(params)
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_encdec_attn_matches_torch():
+    attn = EncdecMultiheadAttn(H, NH, bias=False)
+    params = attn.init(jax.random.PRNGKey(6))
+    tm = _torch_mha(H, NH)
+    with torch.no_grad():
+        w = np.concatenate([np.asarray(params["q"]["weight"]),
+                            np.asarray(params["kv"]["weight"])], axis=0)
+        tm.in_proj_weight.copy_(torch.tensor(w))
+        tm.out_proj.weight.copy_(torch.tensor(
+            np.asarray(params["out"]["weight"])))
+
+    rng = np.random.RandomState(7)
+    q = rng.randn(T, B, H).astype(np.float32)
+    mem = rng.randn(T + 4, B, H).astype(np.float32)
+    out = attn(params, jnp.asarray(q), jnp.asarray(mem))
+    tout, _ = tm(torch.tensor(q), torch.tensor(mem), torch.tensor(mem))
+    np.testing.assert_allclose(np.asarray(out), tout.detach().numpy(),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_dropout_path_runs():
+    attn = SelfMultiheadAttn(H, NH, dropout=0.3)
+    params = attn.init(jax.random.PRNGKey(8))
+    x = jnp.asarray(np.random.RandomState(9).randn(T, B, H), jnp.float32)
+    out1 = attn(params, x, dropout_rng=jax.random.PRNGKey(1))
+    out2 = attn(params, x, dropout_rng=jax.random.PRNGKey(2))
+    assert not np.allclose(np.asarray(out1), np.asarray(out2))
+    # eval (no rng) is deterministic
+    np.testing.assert_allclose(np.asarray(attn(params, x)),
+                               np.asarray(attn(params, x)))
