@@ -17,6 +17,8 @@ from typing import List, Optional, Sequence
 from apex_tpu.optimizers.larc import LARC  # noqa: F401
 from apex_tpu.parallel.distributed import (  # noqa: F401
     DistributedDataParallel, Reducer, allreduce_grads)
+from apex_tpu.parallel.spatial import (  # noqa: F401
+    halo_exchange, spatial_conv2d)
 from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
     BatchNormState, SyncBatchNorm, sync_batch_norm)
 
