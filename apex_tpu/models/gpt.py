@@ -103,9 +103,9 @@ class GPTModel:
         self.embedding = VocabParallelEmbedding(
             cfg.vocab_size, cfg.hidden_size, init_method=init,
             params_dtype=cfg.params_dtype, world_size=tp)
-        sp = cfg.sequence_parallel and tp > 1
         if cfg.sequence_parallel and tp <= 1:
             raise ValueError("sequence_parallel requires tp > 1")
+        sp = cfg.sequence_parallel
         self.qkv = ColumnParallelLinear(
             cfg.hidden_size, 3 * cfg.hidden_size, gather_output=False,
             init_method=init, params_dtype=cfg.params_dtype, world_size=tp,
@@ -350,6 +350,12 @@ class GPTModel:
         (``schedules/common.py:29-148``)."""
         if self.cfg.num_layers % num_stages:
             raise ValueError("num_layers must divide num_stages")
+        if self.cfg.sequence_parallel:
+            raise NotImplementedError(
+                "sequence_parallel does not compose with the pipeline "
+                "decomposition yet: the embed/head closures would run on "
+                "sequence shards without the SP gathers and the shared LN "
+                "grads would skip sp_grad_sync")
         per = self.cfg.num_layers // num_stages
 
         def stage(stage_params: dict, x: jnp.ndarray, stage_idx) -> jnp.ndarray:

@@ -49,7 +49,56 @@ def _unheads(x):
     return jnp.transpose(x, (2, 0, 1, 3)).reshape(t, b, nh * dh)
 
 
-class SelfMultiheadAttn:
+def _mask_bias(key_padding_mask):
+    """(B, T) True=pad -> additive (B, 1, 1, T) bias (the reference's
+    -10000 padding-mask convention)."""
+    if key_padding_mask is None:
+        return None
+    return jnp.where(key_padding_mask[:, None, None, :], -10000.0,
+                     0.0).astype(jnp.float32)
+
+
+def _dropout_seed(dropout_rng):
+    if dropout_rng is None:
+        return None
+    return jax.random.randint(dropout_rng, (), 0, 2 ** 31 - 1)
+
+
+class _MultiheadBase:
+    def __init__(self, embed_dim: int, num_heads: int, dropout: float,
+                 bias: bool, include_norm_add: bool):
+        if embed_dim % num_heads:
+            raise ValueError(
+                f"num_heads ({num_heads}) must divide embed_dim "
+                f"({embed_dim})")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.dropout = dropout
+        self.use_bias = bias
+        self.include_norm_add = include_norm_add
+
+    def _maybe_norm(self, params, x):
+        if not self.include_norm_add:
+            return x
+        return fused_layer_norm_affine(
+            x, params["lyr_nrm"]["weight"].astype(x.dtype),
+            params["lyr_nrm"]["bias"].astype(x.dtype), self.embed_dim)
+
+    def _norm_params(self):
+        if not self.include_norm_add:
+            return {}
+        return {"lyr_nrm": {"weight": jnp.ones(self.embed_dim),
+                            "bias": jnp.zeros(self.embed_dim)}}
+
+    def _out_proj(self, params, ctx, residual):
+        out = _unheads(ctx) @ params["out"]["weight"].astype(
+            ctx.dtype).T
+        if self.use_bias:
+            out = out + params["out"]["bias"].astype(out.dtype)
+        return residual + out if self.include_norm_add else out
+
+
+class SelfMultiheadAttn(_MultiheadBase):
     """``reference:apex/contrib/multihead_attn/self_multihead_attn.py``.
 
     ``__call__(params, x, ...)`` with ``x`` (T, B, H); returns (T, B, H).
@@ -59,26 +108,19 @@ class SelfMultiheadAttn:
 
     def __init__(self, embed_dim: int, num_heads: int, dropout: float = 0.0,
                  bias: bool = False, include_norm_add: bool = False):
-        if embed_dim % num_heads:
-            raise ValueError("embed_dim must divide num_heads")
-        self.embed_dim = embed_dim
-        self.num_heads = num_heads
-        self.dropout = dropout
-        self.use_bias = bias
-        self.include_norm_add = include_norm_add
+        super().__init__(embed_dim, num_heads, dropout, bias,
+                         include_norm_add)
 
     def init(self, key: jax.Array) -> dict:
         k1, k2 = jax.random.split(key)
         p = {"qkv": {"weight": _xavier(k1, (3 * self.embed_dim,
                                             self.embed_dim))},
              "out": {"weight": _xavier(k2, (self.embed_dim,
-                                            self.embed_dim))}}
+                                            self.embed_dim))},
+             **self._norm_params()}
         if self.use_bias:
             p["qkv"]["bias"] = jnp.zeros(3 * self.embed_dim)
             p["out"]["bias"] = jnp.zeros(self.embed_dim)
-        if self.include_norm_add:
-            p["lyr_nrm"] = {"weight": jnp.ones(self.embed_dim),
-                            "bias": jnp.zeros(self.embed_dim)}
         return p
 
     def __call__(self, params: dict, x: jnp.ndarray,
@@ -86,45 +128,29 @@ class SelfMultiheadAttn:
                  attn_mask_causal: bool = False,
                  dropout_rng=None) -> jnp.ndarray:
         residual = x
-        if self.include_norm_add:
-            x = fused_layer_norm_affine(
-                x, params["lyr_nrm"]["weight"].astype(x.dtype),
-                params["lyr_nrm"]["bias"].astype(x.dtype), self.embed_dim)
+        x = self._maybe_norm(params, x)
         qkv = x @ params["qkv"]["weight"].astype(x.dtype).T
         if self.use_bias:
             qkv = qkv + params["qkv"]["bias"].astype(x.dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        bias = None
-        if key_padding_mask is not None:
-            bias = jnp.where(key_padding_mask[:, None, None, :], -10000.0,
-                             0.0).astype(jnp.float32)
         rate = self.dropout if dropout_rng is not None else 0.0
-        seed = (jax.random.randint(dropout_rng, (), 0, 2 ** 31 - 1)
-                if dropout_rng is not None else None)
         ctx = flash_attention(
             _heads(q, self.num_heads), _heads(k, self.num_heads),
-            _heads(v, self.num_heads), bias=bias, causal=attn_mask_causal,
-            dropout_rate=rate, dropout_seed=seed)
-        out = _unheads(ctx) @ params["out"]["weight"].astype(x.dtype).T
-        if self.use_bias:
-            out = out + params["out"]["bias"].astype(x.dtype)
-        return residual + out if self.include_norm_add else out
+            _heads(v, self.num_heads), bias=_mask_bias(key_padding_mask),
+            causal=attn_mask_causal, dropout_rate=rate,
+            dropout_seed=_dropout_seed(dropout_rng))
+        return self._out_proj(params, ctx, residual)
 
 
-class EncdecMultiheadAttn:
+class EncdecMultiheadAttn(_MultiheadBase):
     """``reference:apex/contrib/multihead_attn/encdec_multihead_attn.py``:
     queries from the decoder stream, keys/values from the encoder output
     (separate q and kv in-projections)."""
 
     def __init__(self, embed_dim: int, num_heads: int, dropout: float = 0.0,
                  bias: bool = False, include_norm_add: bool = False):
-        if embed_dim % num_heads:
-            raise ValueError("embed_dim must divide num_heads")
-        self.embed_dim = embed_dim
-        self.num_heads = num_heads
-        self.dropout = dropout
-        self.use_bias = bias
-        self.include_norm_add = include_norm_add
+        super().__init__(embed_dim, num_heads, dropout, bias,
+                         include_norm_add)
 
     def init(self, key: jax.Array) -> dict:
         k1, k2, k3 = jax.random.split(key, 3)
@@ -132,14 +158,12 @@ class EncdecMultiheadAttn:
              "kv": {"weight": _xavier(k2, (2 * self.embed_dim,
                                            self.embed_dim))},
              "out": {"weight": _xavier(k3, (self.embed_dim,
-                                            self.embed_dim))}}
+                                            self.embed_dim))},
+             **self._norm_params()}
         if self.use_bias:
             p["q"]["bias"] = jnp.zeros(self.embed_dim)
             p["kv"]["bias"] = jnp.zeros(2 * self.embed_dim)
             p["out"]["bias"] = jnp.zeros(self.embed_dim)
-        if self.include_norm_add:
-            p["lyr_nrm"] = {"weight": jnp.ones(self.embed_dim),
-                            "bias": jnp.zeros(self.embed_dim)}
         return p
 
     def __call__(self, params: dict, query: jnp.ndarray,
@@ -147,29 +171,16 @@ class EncdecMultiheadAttn:
                  key_padding_mask: Optional[jnp.ndarray] = None,
                  dropout_rng=None) -> jnp.ndarray:
         residual = query
-        if self.include_norm_add:
-            query = fused_layer_norm_affine(
-                query, params["lyr_nrm"]["weight"].astype(query.dtype),
-                params["lyr_nrm"]["bias"].astype(query.dtype),
-                self.embed_dim)
+        query = self._maybe_norm(params, query)
         q = query @ params["q"]["weight"].astype(query.dtype).T
         kv = key_value @ params["kv"]["weight"].astype(key_value.dtype).T
         if self.use_bias:
             q = q + params["q"]["bias"].astype(q.dtype)
             kv = kv + params["kv"]["bias"].astype(kv.dtype)
         k, v = jnp.split(kv, 2, axis=-1)
-        bias = None
-        if key_padding_mask is not None:
-            bias = jnp.where(key_padding_mask[:, None, None, :], -10000.0,
-                             0.0).astype(jnp.float32)
         rate = self.dropout if dropout_rng is not None else 0.0
-        seed = (jax.random.randint(dropout_rng, (), 0, 2 ** 31 - 1)
-                if dropout_rng is not None else None)
         ctx = flash_attention(
             _heads(q, self.num_heads), _heads(k, self.num_heads),
-            _heads(v, self.num_heads), bias=bias,
-            dropout_rate=rate, dropout_seed=seed)
-        out = _unheads(ctx) @ params["out"]["weight"].astype(query.dtype).T
-        if self.use_bias:
-            out = out + params["out"]["bias"].astype(query.dtype)
-        return residual + out if self.include_norm_add else out
+            _heads(v, self.num_heads), bias=_mask_bias(key_padding_mask),
+            dropout_rate=rate, dropout_seed=_dropout_seed(dropout_rng))
+        return self._out_proj(params, ctx, residual)

@@ -49,27 +49,8 @@ __all__ = ["DistributedFusedAdam", "DistributedFusedLAMB",
            "ZeroAdamState", "ZeroLambState"]
 
 
-def _all_gather_invariant(shard: jnp.ndarray, axis_name: str,
-                          padded: int, chunk: int) -> jnp.ndarray:
-    """Invariant-typed tiled all-gather of per-rank flat shards.
-
-    The gathered vector is replicated by construction (every rank contributes
-    its disjoint shard), and typing it device-invariant lets callers keep
-    ``P()`` out_specs for params — a plain ``all_gather``'s varying type would
-    fail shard_map's replication check. ``all_gather_invariant`` is private
-    JAX API (``jax._src.lax.parallel``), so it is wrapped here with an
-    equivalent — but slower, O(world x padded) traffic — public-API fallback:
-    place the shard at its offset in a zero vector and psum (disjoint one-hot
-    sum)."""
-    try:
-        from jax._src.lax.parallel import all_gather_invariant
-    except ImportError:  # pragma: no cover - private symbol moved
-        rank = jax.lax.axis_index(axis_name)
-        return jax.lax.psum(
-            jax.lax.dynamic_update_slice_in_dim(
-                jnp.zeros(padded, shard.dtype), shard, rank * chunk, axis=0),
-            axis_name)
-    return all_gather_invariant(shard, axis_name, axis=0, tiled=True)
+# invariant-typed gather shared with the SP/CP layer
+from apex_tpu.utils.vma import invariant_all_gather as _all_gather_flat
 
 
 class ZeroAdamState(NamedTuple):
@@ -117,8 +98,7 @@ class _DistributedFusedBase(OptimizerBase):
         return g / self._dp(lay)
 
     def _gather_params(self, master: jnp.ndarray, lay: FlatLayout) -> Any:
-        flat = _all_gather_invariant(master, self.axis_name, lay.padded,
-                                     lay.chunk)
+        flat = _all_gather_flat(master, self.axis_name, axis=0)
         return unravel(flat, lay)
 
 

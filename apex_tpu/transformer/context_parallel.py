@@ -201,19 +201,8 @@ def gather_from_sequence_parallel_region(x: jnp.ndarray,
     tests/test_models.py::test_gpt_sequence_parallel_matches_tp)."""
     if not invariant:
         return jax.lax.all_gather(x, axis_name, axis=seq_axis, tiled=True)
-    try:
-        from jax._src.lax.parallel import all_gather_invariant
-        return all_gather_invariant(x, axis_name, axis=seq_axis, tiled=True)
-    except ImportError:  # pragma: no cover - private symbol moved
-        tp = jax.lax.axis_size(axis_name)
-        rank = jax.lax.axis_index(axis_name)
-        full = list(x.shape)
-        full[seq_axis] *= tp
-        return jax.lax.psum(
-            jax.lax.dynamic_update_slice_in_dim(
-                jnp.zeros(full, x.dtype), x, rank * x.shape[seq_axis],
-                axis=seq_axis),
-            axis_name)
+    from apex_tpu.utils.vma import invariant_all_gather
+    return invariant_all_gather(x, axis_name, axis=seq_axis)
 
 
 def reduce_scatter_to_sequence_parallel_region(x: jnp.ndarray,

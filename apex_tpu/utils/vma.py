@@ -15,7 +15,7 @@ from typing import Any, Callable, Iterable
 import jax
 import jax.numpy as jnp
 
-__all__ = ["cast_to_vma", "scan_stable_vma"]
+__all__ = ["cast_to_vma", "scan_stable_vma", "invariant_all_gather"]
 
 
 def cast_to_vma(x: jnp.ndarray, vma: frozenset) -> jnp.ndarray:
@@ -49,3 +49,28 @@ def scan_stable_vma(body: Callable, init: Any, xs: Any, max_iters: int = 4):
         return cast_to_vma(new_c, carry_vma), y
 
     return jax.lax.scan(stable_body, cast_to_vma(init, carry_vma), xs)
+
+
+def invariant_all_gather(x: jnp.ndarray, axis_name: str, axis: int = 0
+                         ) -> jnp.ndarray:
+    """Tiled all-gather typed device-INVARIANT: every rank contributes a
+    disjoint slice, so the gathered value is provably replicated and can
+    cross ``P()`` out_specs / keep replicated-param AD semantics (a plain
+    ``all_gather``'s varying type cannot). Wraps the private
+    ``jax._src.lax.parallel.all_gather_invariant`` with an equivalent —
+    slower, O(world x size) traffic — public-API fallback: place the slice
+    at its offset in zeros and psum (disjoint one-hot sum). Shared by the
+    ZeRO param gather and the sequence-parallel gathers."""
+    try:
+        from jax._src.lax.parallel import all_gather_invariant
+    except ImportError:  # pragma: no cover - private symbol moved
+        size = jax.lax.axis_size(axis_name)
+        rank = jax.lax.axis_index(axis_name)
+        full = list(x.shape)
+        full[axis] *= size
+        return jax.lax.psum(
+            jax.lax.dynamic_update_slice_in_dim(
+                jnp.zeros(full, x.dtype), x, rank * x.shape[axis],
+                axis=axis),
+            axis_name)
+    return all_gather_invariant(x, axis_name, axis=axis, tiled=True)
