@@ -5,6 +5,7 @@ Reference export list: ``reference:apex/transformer/__init__.py:1-23``.
 
 from apex_tpu.transformer import amp  # noqa: F401
 from apex_tpu.transformer import context_parallel  # noqa: F401
+from apex_tpu.transformer import expert_parallel  # noqa: F401
 from apex_tpu.transformer import parallel_state  # noqa: F401
 from apex_tpu.transformer import pipeline_parallel  # noqa: F401
 from apex_tpu.transformer import tensor_parallel  # noqa: F401
@@ -16,8 +17,8 @@ from apex_tpu.ops.fused_softmax import FusedScaleMaskSoftmax  # noqa: F401
 from apex_tpu.ops import fused_softmax as functional  # noqa: F401
 
 __all__ = [
-    "amp", "context_parallel", "functional", "parallel_state",
-    "pipeline_parallel",
+    "amp", "context_parallel", "expert_parallel", "functional",
+    "parallel_state", "pipeline_parallel",
     "tensor_parallel", "AttnMaskType", "AttnType", "LayerType", "ModelType",
     "FusedScaleMaskSoftmax",
 ]
