@@ -77,6 +77,15 @@ def supports_pallas(n_rows: int, hidden: int) -> bool:
     return n_rows <= cap or (cap >= 8 and n_rows % 8 == 0)
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying the varying-manual-axes of ``like`` (see
+    the flash-attention twin: pallas_call under shard_map needs it)."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _stats(xf: jnp.ndarray, eps: float, rms: bool):
     if rms:
         ms = jnp.mean(xf * xf, axis=1, keepdims=True)
@@ -177,9 +186,9 @@ def ln_fwd(x2d: jnp.ndarray, weight: Optional[jnp.ndarray],
         in_specs=in_specs,
         out_specs=(row_spec, stat_spec, stat_spec),
         out_shape=(
-            jax.ShapeDtypeStruct((n, h), out_dtype),
-            jax.ShapeDtypeStruct((n, 1), jnp.float32),
-            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            _sds((n, h), out_dtype, x2d),
+            _sds((n, 1), jnp.float32, x2d),
+            _sds((n, 1), jnp.float32, x2d),
         ),
     )(*args)
 
@@ -206,13 +215,13 @@ def ln_bwd(dy2d: jnp.ndarray, x2d: jnp.ndarray, mean: jnp.ndarray,
         args.append(weight.reshape(1, h))
 
     out_specs = [row_spec]
-    out_shape = [jax.ShapeDtypeStruct((n, h), x_dtype)]
+    out_shape = [_sds((n, h), x_dtype, x2d)]
     if has_w:
         out_specs.append(acc_spec)
-        out_shape.append(jax.ShapeDtypeStruct((1, h), jnp.float32))
+        out_shape.append(_sds((1, h), jnp.float32, x2d))
     if has_bias:
         out_specs.append(acc_spec)
-        out_shape.append(jax.ShapeDtypeStruct((1, h), jnp.float32))
+        out_shape.append(_sds((1, h), jnp.float32, x2d))
 
     def kernel(dy_ref, x_ref, mean_ref, invvar_ref, *refs):
         i = 0

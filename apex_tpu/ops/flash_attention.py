@@ -431,6 +431,16 @@ def _interp() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying the varying-manual-axes of ``like`` — a
+    pallas_call inside ``shard_map`` (check_vma) must declare how its
+    outputs vary; they vary exactly like the q/k/v operands."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _bias_spec(bias4, h, block_q, block_k, *, swapped):
     """BlockSpec for the 4D broadcastable bias ``(bb, hb, sqb, sk)`` where
     ``bb``/``hb``/``sqb`` are each 1 or full: broadcast dims map to block 0
@@ -525,8 +535,8 @@ def _fwd_pallas(q3, k3, v3, bias4, seed, segs, h, *, scale, causal, block_q,
         out_specs=(q_spec,
                    pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0),
                                 memory_space=pltpu.VMEM)),
-        out_shape=(jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
-                   jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32)),
+        out_shape=(_sds((bh, sq, d), q3.dtype, q3),
+                   _sds((bh, sq, 1), jnp.float32, q3)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
                         pltpu.VMEM((block_q, 1), jnp.float32),
                         pltpu.VMEM((block_q, 1), jnp.float32)],
@@ -589,7 +599,7 @@ def _bwd_pallas(q3, k3, v3, bias4, seed, segs, h, do3, lse, delta, *, scale,
         grid=(bh, n_q, n_kv),
         in_specs=in_specs,
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+        out_shape=_sds((bh, sq, d), q3.dtype, q3),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interp(),
     )(*args)
@@ -641,8 +651,8 @@ def _bwd_pallas(q3, k3, v3, bias4, seed, segs, h, do3, lse, delta, *, scale,
         grid=(bh, n_kv, n_q),
         in_specs=in_specs2,
         out_specs=(kv_spec2, kv_spec2),
-        out_shape=(jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
-                   jax.ShapeDtypeStruct((bh, sk, d), v3.dtype)),
+        out_shape=(_sds((bh, sk, d), k3.dtype, k3),
+                   _sds((bh, sk, d), v3.dtype, v3)),
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=_interp(),
@@ -755,7 +765,7 @@ def _dbias_pallas(q3, k3, v3, bias4, seed, segs, h, do3, lse, delta, *,
         grid=grid,
         in_specs=in_specs,
         out_specs=db_spec,
-        out_shape=jax.ShapeDtypeStruct(bias4.shape, jnp.float32),
+        out_shape=_sds(bias4.shape, jnp.float32, q3),
         interpret=_interp(),
     )(*args)
 

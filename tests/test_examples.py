@@ -38,3 +38,9 @@ def test_dcgan_amp_runs():
     import dcgan_amp
     errD, errG = dcgan_amp.main(["--steps", "3", "--batch", "8"])
     assert np.isfinite(errD) and np.isfinite(errG)
+
+
+def test_long_context_example_runs():
+    import long_context
+    val = long_context.main(["--seq-per-device", "64"])
+    assert np.isfinite(val)
