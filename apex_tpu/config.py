@@ -51,6 +51,7 @@ class ParallelConfig:
     tensor_model_parallel_size: int = 1
     pipeline_model_parallel_size: int = 1
     virtual_pipeline_model_parallel_size: Optional[int] = None
+    context_parallel_size: int = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -222,4 +223,5 @@ class TrainConfig:
             self.parallel.pipeline_model_parallel_size,
             virtual_pipeline_model_parallel_size=
             self.parallel.virtual_pipeline_model_parallel_size,
+            context_parallel_size=self.parallel.context_parallel_size,
             devices=devices)

@@ -45,14 +45,17 @@ __all__ = [
     "get_pipeline_model_parallel_next_rank", "get_pipeline_model_parallel_prev_rank",
     "get_pipeline_model_parallel_split_rank",
     "set_pipeline_model_parallel_split_rank",
+    "get_context_parallel_world_size", "get_context_parallel_rank",
+    "get_context_parallel_groups",
     "get_tensor_model_parallel_groups", "get_data_parallel_groups",
     "get_pipeline_model_parallel_groups", "get_embedding_ranks",
     "get_rank_info",
-    "PIPE_AXIS", "DATA_AXIS", "TENSOR_AXIS",
+    "PIPE_AXIS", "DATA_AXIS", "CONTEXT_AXIS", "TENSOR_AXIS",
 ]
 
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
+CONTEXT_AXIS = "context"
 TENSOR_AXIS = "tensor"
 
 _MESH: Optional[Mesh] = None
@@ -66,31 +69,37 @@ def initialize_model_parallel(
     pipeline_model_parallel_size: int = 1,
     virtual_pipeline_model_parallel_size: Optional[int] = None,
     pipeline_model_parallel_split_rank: Optional[int] = None,
+    context_parallel_size: int = 1,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
     """Build and install the global mesh (``parallel_state.py:73-247``).
 
     ``devices`` defaults to ``jax.devices()``; data-parallel size is derived
-    as ``len(devices) / (tp*pp)`` exactly like the reference derives it from
-    world size.
+    as ``len(devices) / (tp*pp*cp)`` exactly like the reference derives it
+    from world size. ``context_parallel_size`` carves a ``context`` axis
+    (for ring/Ulysses attention, :mod:`apex_tpu.transformer.
+    context_parallel`) out of the data dimension — the reference has no CP
+    groups at all (SURVEY §2.3); the layout follows Megatron-LM's later
+    convention: tp fastest, then cp, then dp, then pp.
     """
     global _MESH, _VIRTUAL_PP_SIZE, _VIRTUAL_PP_RANK, _PP_SPLIT_RANK
     if devices is None:
         devices = jax.devices()
     world = len(devices)
     tp, pp = tensor_model_parallel_size, pipeline_model_parallel_size
-    if world % (tp * pp) != 0:
+    cp = context_parallel_size
+    if world % (tp * pp * cp) != 0:
         raise RuntimeError(
             f"world size ({world}) is not divisible by tensor ({tp}) x "
-            f"pipeline ({pp}) parallel sizes")
-    dp = world // (tp * pp)
+            f"pipeline ({pp}) x context ({cp}) parallel sizes")
+    dp = world // (tp * pp * cp)
     if virtual_pipeline_model_parallel_size is not None and pp < 2:
         raise RuntimeError(
             "pipeline-model-parallel size must be at least 2 with the "
             "interleaved schedule")
-    # rank layout: tp fastest, then dp, then pp (parallel_state.py:153-247)
-    grid = np.asarray(devices).reshape(pp, dp, tp)
-    _MESH = Mesh(grid, (PIPE_AXIS, DATA_AXIS, TENSOR_AXIS))
+    # rank layout: tp fastest, then cp, then dp, then pp
+    grid = np.asarray(devices).reshape(pp, dp, cp, tp)
+    _MESH = Mesh(grid, (PIPE_AXIS, DATA_AXIS, CONTEXT_AXIS, TENSOR_AXIS))
     _VIRTUAL_PP_SIZE = virtual_pipeline_model_parallel_size
     _VIRTUAL_PP_RANK = 0 if virtual_pipeline_model_parallel_size else None
     _PP_SPLIT_RANK = pipeline_model_parallel_split_rank
@@ -131,6 +140,10 @@ def get_data_parallel_world_size() -> int:
     return get_mesh().shape[DATA_AXIS]
 
 
+def get_context_parallel_world_size() -> int:
+    return get_mesh().shape[CONTEXT_AXIS]
+
+
 def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
     return _VIRTUAL_PP_SIZE
 
@@ -148,6 +161,10 @@ def get_pipeline_model_parallel_rank():
 
 def get_data_parallel_rank():
     return jax.lax.axis_index(DATA_AXIS)
+
+
+def get_context_parallel_rank():
+    return jax.lax.axis_index(CONTEXT_AXIS)
 
 
 def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
@@ -220,10 +237,11 @@ def get_pipeline_model_parallel_prev_rank():
 
 # -- group enumerations (host-side; for axis_index_groups / debugging) -------
 
-def _global_rank(pp_r: int, dp_r: int, tp_r: int) -> int:
+def _global_rank(pp_r: int, dp_r: int, tp_r: int, cp_r: int = 0) -> int:
     tp = get_tensor_model_parallel_world_size()
+    cp = get_context_parallel_world_size()
     dp = get_data_parallel_world_size()
-    return tp_r + tp * (dp_r + dp * pp_r)
+    return tp_r + tp * (cp_r + cp * (dp_r + dp * pp_r))
 
 
 def get_tensor_model_parallel_groups() -> List[List[int]]:
@@ -231,37 +249,53 @@ def get_tensor_model_parallel_groups() -> List[List[int]]:
     (``parallel_state.py:153-247``); usable as ``axis_index_groups`` over a
     flattened device list."""
     tp = get_tensor_model_parallel_world_size()
+    cp = get_context_parallel_world_size()
     dp = get_data_parallel_world_size()
     pp = get_pipeline_model_parallel_world_size()
-    return [[_global_rank(p, d, t) for t in range(tp)]
-            for p in range(pp) for d in range(dp)]
+    return [[_global_rank(p, d, t, c) for t in range(tp)]
+            for p in range(pp) for d in range(dp) for c in range(cp)]
 
 
 def get_data_parallel_groups() -> List[List[int]]:
     tp = get_tensor_model_parallel_world_size()
+    cp = get_context_parallel_world_size()
     dp = get_data_parallel_world_size()
     pp = get_pipeline_model_parallel_world_size()
-    return [[_global_rank(p, d, t) for d in range(dp)]
-            for p in range(pp) for t in range(tp)]
+    return [[_global_rank(p, d, t, c) for d in range(dp)]
+            for p in range(pp) for c in range(cp) for t in range(tp)]
+
+
+def get_context_parallel_groups() -> List[List[int]]:
+    tp = get_tensor_model_parallel_world_size()
+    cp = get_context_parallel_world_size()
+    dp = get_data_parallel_world_size()
+    pp = get_pipeline_model_parallel_world_size()
+    return [[_global_rank(p, d, t, c) for c in range(cp)]
+            for p in range(pp) for d in range(dp) for t in range(tp)]
 
 
 def get_pipeline_model_parallel_groups() -> List[List[int]]:
     tp = get_tensor_model_parallel_world_size()
+    cp = get_context_parallel_world_size()
     dp = get_data_parallel_world_size()
     pp = get_pipeline_model_parallel_world_size()
-    return [[_global_rank(p, d, t) for p in range(pp)]
-            for d in range(dp) for t in range(tp)]
+    return [[_global_rank(p, d, t, c) for p in range(pp)]
+            for d in range(dp) for c in range(cp) for t in range(tp)]
 
 
 def get_embedding_ranks() -> List[List[int]]:
-    """First+last stage per (dp, tp) column (``parallel_state.py:215-247``)."""
+    """First+last stage per (dp, cp, tp) column
+    (``parallel_state.py:215-247``)."""
     tp = get_tensor_model_parallel_world_size()
+    cp = get_context_parallel_world_size()
     dp = get_data_parallel_world_size()
     pp = get_pipeline_model_parallel_world_size()
+    cols = [(d, c, t) for d in range(dp) for c in range(cp)
+            for t in range(tp)]
     if pp == 1:
-        return [[_global_rank(0, d, t)] for d in range(dp) for t in range(tp)]
-    return [[_global_rank(0, d, t), _global_rank(pp - 1, d, t)]
-            for d in range(dp) for t in range(tp)]
+        return [[_global_rank(0, d, t, c)] for d, c, t in cols]
+    return [[_global_rank(0, d, t, c), _global_rank(pp - 1, d, t, c)]
+            for d, c, t in cols]
 
 
 def get_rank_info() -> Tuple[int, int, int, Optional[int]]:

@@ -175,3 +175,40 @@ def test_ulysses_attention_grads_and_validation(mesh):
         shard_map(
             lambda q, k, v: ulysses_attention(q, k, v, "context"),
             mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)(q3, k3, v3)
+
+
+def test_context_axis_in_parallel_state():
+    """context_parallel_size carves a first-class mesh axis; ring attention
+    runs over it inside the hybrid mesh, and the flat-rank group
+    enumerations account for the new dimension."""
+    from apex_tpu.transformer import parallel_state
+
+    m = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size=2, context_parallel_size=2)
+    try:
+        assert parallel_state.get_context_parallel_world_size() == 2
+        assert parallel_state.get_data_parallel_world_size() == 2
+        # layout: tp fastest, then cp, then dp
+        assert parallel_state.get_tensor_model_parallel_groups()[:2] == [
+            [0, 1], [2, 3]]
+        assert parallel_state.get_context_parallel_groups()[:2] == [
+            [0, 2], [1, 3]]
+        assert parallel_state.get_data_parallel_groups()[0] == [0, 4]
+
+        q, k, v = _qkv(b=1, h=2, s=32, d=8, seed=10)
+
+        def run(q, k, v):
+            def inner(q, k, v):
+                out = ring_attention(q, k, v, "context", causal=True)
+                return jax.lax.pmean(jax.lax.pmean(
+                    jax.lax.pmean(out, "data"), "tensor"), "pipe")
+            spec = P(None, None, "context", None)
+            return shard_map(inner, mesh=m, in_specs=(spec,) * 3,
+                             out_specs=spec)(q, k, v)
+
+        out = jax.jit(run)(q, k, v)
+        ref = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+    finally:
+        parallel_state.destroy_model_parallel()
