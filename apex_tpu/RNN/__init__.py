@@ -1,22 +1,274 @@
-"""Deprecated stub (SURVEY §7.7): ``apex.RNN`` has no TPU port.
+"""``apex.RNN`` — fp16/bf16-friendly RNN family, TPU-native.
 
-The reference package (``reference:apex/RNN/``) is a deprecated
-fp16-friendly RNN/LSTM/GRU/mLSTM reimplementation whose upstream docs say
-"use torch.nn RNNs". The TPU-native migration:
+Reference surface: ``reference:apex/RNN/__init__.py:1`` exports
+``LSTM, GRU, ReLU, Tanh, mLSTM`` factories (``models.py:19-53``) built from
+``stackedRNN``/``bidirectionalRNN``/``RNNCell`` (``RNNBackend.py:25,90,232``)
+and the multiplicative-LSTM cell (``cells.py:55``). Cell math is the
+torch-standard LSTM/GRU/RNN set (the reference imports
+``torch.nn._functions.rnn`` cells) plus mLSTM:
+``m = (x @ Wmih^T) * (h @ Wmhh^T); gates = x @ Wih^T + m @ Whh^T + b``.
 
-- plain ``flax.linen.LSTMCell``/``GRUCell`` under ``jax.lax.scan`` —
-  fp16/bf16-safe out of the box (XLA accumulates in fp32);
-- per-op precision control via :func:`apex_tpu.amp.o1_context` if a cast
-  policy is needed.
+TPU design — not a module-graph translation:
 
-Any attribute access raises with this guidance.
+* The input-to-hidden projection for ALL timesteps is one big
+  ``(T*B, in) x (in, G)`` matmul hoisted out of the recurrence (MXU-sized),
+  so the ``lax.scan`` body only carries the unavoidable ``h @ Whh^T``.
+* Mixed precision follows the house rule: gate matmuls accumulate fp32
+  (``preferred_element_type``), activations/state stay in the input dtype,
+  so bf16 sequences train without an analog of the reference's
+  fused-pointwise fp16 kernels (``RNNBackend.py``'s fusedBackend).
+* ``bidirectional`` runs the reversed scan and concatenates features;
+  ``dropout`` applies between stacked layers (not after the last), matching
+  torch/``stackedRNN`` semantics.
+
+Protocol matches the repo's param-factory style::
+
+    rnn = LSTM(input_size=32, hidden_size=64, num_layers=2)
+    params = rnn.init(jax.random.PRNGKey(0))
+    out, (h, c) = rnn(params, x)            # x: (T, B, in); out: (T, B, H)
 """
 
-_MSG = ("apex_tpu.RNN is a documented stub: the reference package is "
-        "deprecated. Use flax.linen LSTM/GRU cells under jax.lax.scan "
-        "(bf16-safe natively); see apex_tpu/RNN/__init__.py for the "
-        "migration notes.")
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["LSTM", "GRU", "ReLU", "Tanh", "mLSTM", "ApexRNN"]
 
 
-def __getattr__(name):
-    raise NotImplementedError(_MSG)
+def _linear(x: jnp.ndarray, w: jnp.ndarray,
+            b: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """``x @ w.T (+ b)`` with fp32 MXU accumulation, cast back to x dtype."""
+    y = jax.lax.dot_general(x, w, (((x.ndim - 1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if b is not None:
+        y = y + b.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+# gate multiplier + #hidden-states per cell kind (RNNBackend.py:242
+# gate_multiplier / n_hidden_states)
+_CELLS = {
+    "lstm": (4, 2),
+    "gru": (3, 1),
+    "relu": (1, 1),
+    "tanh": (1, 1),
+    "mlstm": (4, 2),
+}
+
+
+def _cell_step(kind: str, xg: jnp.ndarray, h: jnp.ndarray,
+               c: Optional[jnp.ndarray], p: dict) -> Tuple[jnp.ndarray,
+                                                           Optional[jnp.ndarray]]:
+    """One recurrence step. ``xg`` is the precomputed input projection
+    ``x @ Wih^T + b_ih`` for this timestep. Returns (h', c')."""
+    f32 = jnp.float32
+    if kind == "lstm" or kind == "mlstm":
+        if kind == "mlstm":
+            # cells.py:55 — multiplicative intermediate replaces h in the
+            # hidden-to-hidden projection
+            hm = p["xm"] * _linear(h, p["w_mhh"])
+            gates = (xg + _linear(hm, p["w_hh"], p.get("b_hh"))).astype(f32)
+        else:
+            gates = (xg + _linear(h, p["w_hh"], p.get("b_hh"))).astype(f32)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c.astype(f32) + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new.astype(h.dtype), c_new.astype(h.dtype)
+    if kind == "gru":
+        hg = _linear(h, p["w_hh"], p.get("b_hh")).astype(f32)
+        xgf = xg.astype(f32)
+        hdim = h.shape[-1]
+        r = jax.nn.sigmoid(xgf[..., :hdim] + hg[..., :hdim])
+        z = jax.nn.sigmoid(xgf[..., hdim:2 * hdim] + hg[..., hdim:2 * hdim])
+        n = jnp.tanh(xgf[..., 2 * hdim:] + r * hg[..., 2 * hdim:])
+        h_new = (1.0 - z) * n + z * h.astype(f32)
+        return h_new.astype(h.dtype), None
+    act = jax.nn.relu if kind == "relu" else jnp.tanh
+    pre = (xg + _linear(h, p["w_hh"], p.get("b_hh"))).astype(f32)
+    return act(pre).astype(h.dtype), None
+
+
+@dataclasses.dataclass
+class ApexRNN:
+    """Stacked (optionally bidirectional) RNN over one cell kind."""
+
+    kind: str
+    input_size: int
+    hidden_size: int
+    num_layers: int = 1
+    bias: bool = True
+    batch_first: bool = False
+    dropout: float = 0.0
+    bidirectional: bool = False
+    output_size: Optional[int] = None
+    params_dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if self.kind not in _CELLS:
+            raise ValueError(f"unknown cell kind {self.kind!r}")
+        self.gate_mult, self.n_states = _CELLS[self.kind]
+        # RNNBackend.py:232 RNNCell(output_size): h is projected by w_ho
+        # when output_size != hidden_size
+        self.proj = (self.output_size is not None
+                     and self.output_size != self.hidden_size)
+        if self.proj and self.kind == "gru":
+            # torch's GRUCell mixes h into the candidate elementwise, so a
+            # projected hidden of a different width cannot type-check (the
+            # reference inherits the same limitation)
+            raise ValueError("output_size projection is not defined for GRU")
+        self.out_size = self.output_size if self.proj else self.hidden_size
+
+    # -- params -------------------------------------------------------------
+
+    def _layer_init(self, key, in_size: int) -> dict:
+        h, g = self.hidden_size, self.gate_mult
+        bound = 1.0 / (h ** 0.5)  # torch RNN reset_parameters
+        ks = jax.random.split(key, 7)
+        u = lambda k, shape: jax.random.uniform(
+            k, shape, self.params_dtype, -bound, bound)
+        p = {"w_ih": u(ks[0], (g * h, in_size)),
+             "w_hh": u(ks[1], (g * h, self.out_size))}
+        if self.bias:
+            p["b_ih"] = u(ks[2], (g * h,))
+            p["b_hh"] = u(ks[3], (g * h,))
+        if self.kind == "mlstm":
+            p["w_mih"] = u(ks[4], (h, in_size))
+            p["w_mhh"] = u(ks[5], (h, self.out_size))
+        if self.proj:
+            p["w_ho"] = u(ks[6], (self.out_size, h))
+        return p
+
+    def init(self, key: jax.Array) -> dict:
+        dirs = 2 if self.bidirectional else 1
+        keys = jax.random.split(key, self.num_layers * dirs)
+        params = {}
+        for layer in range(self.num_layers):
+            in_size = (self.input_size if layer == 0
+                       else self.out_size * dirs)
+            for d in range(dirs):
+                params[f"l{layer}{'_rev' if d else ''}"] = self._layer_init(
+                    keys[layer * dirs + d], in_size)
+        return params
+
+    def init_hidden(self, batch: int, dtype=None) -> Any:
+        """Zero hidden state, torch layout ``(layers*dirs, B, H)``
+        (``RNNBackend.py:309`` init_hidden)."""
+        dirs = 2 if self.bidirectional else 1
+        dtype = dtype or self.params_dtype
+        h = jnp.zeros((self.num_layers * dirs, batch, self.out_size), dtype)
+        if self.n_states == 2:
+            c = jnp.zeros((self.num_layers * dirs, batch, self.hidden_size),
+                          dtype)
+            return (h, c)
+        return h
+
+    # -- forward ------------------------------------------------------------
+
+    def _run_layer(self, p: dict, x: jnp.ndarray, h0, c0,
+                   reverse: bool) -> Tuple[jnp.ndarray, Any]:
+        """x: (T, B, in) -> (T, B, out). The input projection for every
+        timestep is one hoisted matmul; the scan carries only h (+ c)."""
+        xg = _linear(x, p["w_ih"], p.get("b_ih"))       # (T, B, g*h)
+        xm = _linear(x, p["w_mih"]) if self.kind == "mlstm" else None
+
+        def step(carry, inputs):
+            h, c = carry
+            if self.kind == "mlstm":
+                xg_t, xm_t = inputs
+                pc = dict(p, xm=xm_t)
+            else:
+                xg_t, pc = inputs, p
+            h_new, c_new = _cell_step(self.kind, xg_t, h, c, pc)
+            if self.proj:
+                h_new = _linear(h_new, p["w_ho"])
+            return (h_new, c_new), h_new
+
+        xs = (xg, xm) if self.kind == "mlstm" else xg
+        (h_f, c_f), ys = jax.lax.scan(step, (h0, c0), xs, reverse=reverse)
+        return ys, (h_f, c_f)
+
+    def __call__(self, params: dict, x: jnp.ndarray, hidden: Any = None,
+                 dropout_rng: Optional[jax.Array] = None
+                 ) -> Tuple[jnp.ndarray, Any]:
+        """Returns ``(output, h)`` or ``(output, (h, c))``; layouts follow
+        torch (seq-major unless ``batch_first``)."""
+        if self.batch_first:
+            x = jnp.swapaxes(x, 0, 1)
+        T, B = x.shape[0], x.shape[1]
+        dirs = 2 if self.bidirectional else 1
+        if hidden is None:
+            hidden = self.init_hidden(B, x.dtype)
+        if self.n_states == 2:
+            h_all, c_all = hidden
+        else:
+            h_all, c_all = hidden, None
+
+        h_out, c_out = [], []
+        for layer in range(self.num_layers):
+            outs = []
+            for d in range(dirs):
+                idx = layer * dirs + d
+                p = params[f"l{layer}{'_rev' if d else ''}"]
+                c0 = (c_all[idx].astype(x.dtype)
+                      if c_all is not None else None)
+                ys, (h_f, c_f) = self._run_layer(
+                    p, x, h_all[idx].astype(x.dtype), c0, reverse=bool(d))
+                outs.append(ys)
+                h_out.append(h_f)
+                if c_f is not None:
+                    c_out.append(c_f)
+            x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+            if (self.dropout > 0.0 and dropout_rng is not None
+                    and layer < self.num_layers - 1):
+                key = jax.random.fold_in(dropout_rng, layer)
+                keep = 1.0 - self.dropout
+                mask = jax.random.bernoulli(key, keep, x.shape)
+                x = jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+        out = jnp.swapaxes(x, 0, 1) if self.batch_first else x
+        h_stack = jnp.stack(h_out)
+        if self.n_states == 2:
+            return out, (h_stack, jnp.stack(c_out))
+        return out, h_stack
+
+
+def LSTM(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+         dropout=0.0, bidirectional=False, output_size=None, **kw):
+    """``reference:apex/RNN/models.py:19``."""
+    return ApexRNN("lstm", input_size, hidden_size, num_layers, bias,
+                   batch_first, dropout, bidirectional, output_size, **kw)
+
+
+def GRU(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+        dropout=0.0, bidirectional=False, output_size=None, **kw):
+    """``reference:apex/RNN/models.py:26``."""
+    return ApexRNN("gru", input_size, hidden_size, num_layers, bias,
+                   batch_first, dropout, bidirectional, output_size, **kw)
+
+
+def ReLU(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+         dropout=0.0, bidirectional=False, output_size=None, **kw):
+    """``reference:apex/RNN/models.py:33``."""
+    return ApexRNN("relu", input_size, hidden_size, num_layers, bias,
+                   batch_first, dropout, bidirectional, output_size, **kw)
+
+
+def Tanh(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+         dropout=0.0, bidirectional=False, output_size=None, **kw):
+    """``reference:apex/RNN/models.py:40``."""
+    return ApexRNN("tanh", input_size, hidden_size, num_layers, bias,
+                   batch_first, dropout, bidirectional, output_size, **kw)
+
+
+def mLSTM(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+          dropout=0.0, bidirectional=False, output_size=None, **kw):
+    """``reference:apex/RNN/models.py:47`` / ``cells.py:55`` — the
+    multiplicative LSTM (Krause et al.)."""
+    return ApexRNN("mlstm", input_size, hidden_size, num_layers, bias,
+                   batch_first, dropout, bidirectional, output_size, **kw)

@@ -318,27 +318,16 @@ class GPTModel:
         return jnp.mean(per_tok)
 
     def sp_grad_sync(self, grads: dict) -> dict:
-        """Sum LayerNorm grads over the tensor axis under sequence
-        parallelism. SP computes norms on sequence shards, so their param
-        grads emerge as per-rank partials — Megatron-LM marks those params
-        ``sequence_parallel`` and allreduces them separately
-        (Megatron-LM ``allreduce_sequence_parallel_grad``); this is that
-        allreduce. No-op when SP is off. Call on the grads before the
-        optimizer step (other grads are already replicated/TP-reduced)."""
-        if not self.cfg.sequence_parallel:
-            return grads
-
-        def ps(t):
-            return jax.tree_util.tree_map(
-                lambda g: jax.lax.psum(g, TENSOR_AXIS), t)
-
-        out = dict(grads)
-        out["final_ln"] = ps(grads["final_ln"])
-        layers = dict(grads["layers"])
-        layers["ln1"] = ps(layers["ln1"])
-        layers["ln2"] = ps(layers["ln2"])
-        out["layers"] = layers
-        return out
+        """Megatron-LM allreduces the grads of ``sequence_parallel``-marked
+        params (the LayerNorms) in a separate pass
+        (``allreduce_sequence_parallel_grad``) because torch autograd hands
+        back per-rank partials. Here that reduction lives *inside* the
+        fused-LN custom_vjp (``reconcile_cotangent`` psums replicated-param
+        cotangents over the axes the activations vary on — the same total
+        plain-op AD produces), so grads arrive at the optimizer already
+        synced and this is an intentional no-op, retained for API parity
+        with the Megatron training-loop call sequence."""
+        return grads
 
     # -- pipeline integration ----------------------------------------------
 

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.normalization import _pallas
+from apex_tpu.utils.vma import reconcile_cotangent
 
 __all__ = [
     "fused_layer_norm", "fused_layer_norm_affine",
@@ -104,14 +105,23 @@ def _make_core(rms: bool, eps: float, out_dtype_name: str, use_pallas: bool,
 
     def core_fwd(x2d, weight, bias):
         out, mean, invvar = fwd_impl(x2d, weight, bias)
-        return out, (x2d, mean, invvar, weight)
+        return out, (x2d, mean, invvar, weight, bias)
 
     def core_bwd(res, dy):
-        x2d, mean, invvar, weight = res
+        x2d, mean, invvar, weight, bias = res
         dx, dw, db = bwd_impl(dy, x2d, mean, invvar, weight)
-        return (dx,
-                dw if has_weight else jnp.zeros((), jnp.float32),
-                db if has_bias else jnp.zeros((), jnp.float32))
+        # Under shard_map the bwd must hand back cotangents typed exactly
+        # like the primals. Sequence parallelism is the live case: x2d is
+        # sequence-sharded (tensor-varying) while weight/bias are replicated,
+        # so dw/db emerge as per-rank partials — reconcile_cotangent psums
+        # them over the tensor axis, matching what plain-op AD does for
+        # replicated params (Megatron-LM instead defers this to a separate
+        # allreduce of sequence_parallel-marked params).
+        return (reconcile_cotangent(dx, x2d),
+                reconcile_cotangent(
+                    dw if has_weight else jnp.zeros((), jnp.float32), weight),
+                reconcile_cotangent(
+                    db if has_bias else jnp.zeros((), jnp.float32), bias))
 
     core.defvjp(core_fwd, core_bwd)
     return core

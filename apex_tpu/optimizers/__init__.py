@@ -8,7 +8,7 @@ whole parameter set, which is the TPU analog of the one-kernel
 from apex_tpu.optimizers._base import OptimizerBase  # noqa: F401
 from apex_tpu.optimizers.distributed_fused import (  # noqa: F401
     DistributedFusedAdam, DistributedFusedLAMB, ZeroAdamState, ZeroLambState)
-from apex_tpu.optimizers.flat import FlatOptimizer  # noqa: F401
+from apex_tpu.optimizers.flat import FlatOptimizer, FlatState  # noqa: F401
 from apex_tpu.optimizers.fused_adam import (  # noqa: F401
     AdagradState, AdamState, FusedAdagrad, FusedAdam)
 from apex_tpu.optimizers.fused_lamb import (  # noqa: F401
@@ -23,6 +23,7 @@ __all__ = [
     "DistributedFusedAdam", "ZeroAdamState",
     "DistributedFusedLAMB", "ZeroLambState",
     "FlatOptimizer",
+    "FlatState",
     "FusedAdam", "AdamState",
     "FusedAdagrad", "AdagradState",
     "FusedLAMB", "LAMBState",

@@ -15,7 +15,32 @@ from typing import Any, Callable, Iterable
 import jax
 import jax.numpy as jnp
 
-__all__ = ["cast_to_vma", "scan_stable_vma", "invariant_all_gather"]
+__all__ = ["cast_to_vma", "scan_stable_vma", "invariant_all_gather",
+           "reconcile_cotangent"]
+
+
+def reconcile_cotangent(ct: jnp.ndarray, primal: jnp.ndarray) -> jnp.ndarray:
+    """Match a ``custom_vjp`` bwd output's varying-axes type to its primal's.
+
+    Plain-op AD under ``shard_map`` auto-pvaries a replicated operand that
+    meets device-varying data, so the pvary transpose psums the cotangent
+    back to the replicated total. A ``custom_vjp`` bwd rule sidesteps that
+    machinery and must reconcile by hand — newer jax raises when the bwd
+    output's varying axes differ from the primal's. Axes the cotangent has
+    but the primal lacks are psummed (the chain-rule total for a replicated
+    primal — identical to what plain AD produces); axes the primal has but
+    the cotangent lacks are pvaried (type-only, value-preserving). No-op
+    when the types already agree.
+    """
+    ct_vma = getattr(jax.typeof(ct), "vma", frozenset()) or frozenset()
+    p_vma = getattr(jax.typeof(primal), "vma", frozenset()) or frozenset()
+    extra = tuple(sorted(ct_vma - p_vma))
+    if extra:
+        ct = jax.lax.psum(ct, extra)
+    missing = tuple(sorted(p_vma - ct_vma))
+    if missing:
+        ct = jax.lax.pcast(ct, missing, to="varying")
+    return ct
 
 
 def cast_to_vma(x: jnp.ndarray, vma: frozenset) -> jnp.ndarray:

@@ -221,31 +221,54 @@ def bench_layernorm():
 
 
 def bench_optimizer():
-    """BASELINE config 3: FusedAdam step time over an RN50-sized param tree,
-    per-leaf tree_map vs the FlatOptimizer flat-buffer tier."""
+    """BASELINE config 3: FusedAdam step time over an RN50-sized param tree —
+    per-leaf tree_map vs the persistent-flat FlatOptimizer tier (state stays
+    flat across steps; grads arrive flat, as the grad-w.r.t.-flat training
+    pattern produces). A second point stresses leaf-count pathology (1024
+    tiny leaves), the regime ``multi_tensor_apply`` exists for."""
     from apex_tpu.models import ResNet50, ResNetConfig
     from apex_tpu.optimizers import FlatOptimizer, FusedAdam
 
-    model = ResNet50(ResNetConfig(num_classes=1000))
-    params, _ = model.init(jax.random.PRNGKey(0))
-    grads = jax.tree_util.tree_map(
-        lambda p: jnp.full(jnp.shape(p), 1e-4, jnp.float32), params)
-
-    def run(opt):
+    def run_per_leaf(params, grads, k=20):
+        opt = FusedAdam(lr=1e-3)
         state = opt.init(params)
 
         def step(carry):
             p, s = carry
             return opt.step(grads, s, p)
 
-        return _device_loop_ms(step, (params, state), k=20)
+        return _device_loop_ms(step, (params, state), k=k)
 
-    leaf_ms, _ = run(FusedAdam(lr=1e-3))
-    flat_ms, flat_std = run(FlatOptimizer(FusedAdam(lr=1e-3)))
+    def run_flat(params, k=20):
+        opt = FlatOptimizer(FusedAdam(lr=1e-3))
+        fstate = opt.init_flat(params)
+        flat_grads = jnp.full_like(fstate.flat_params, 1e-4)
+
+        def step(fstate):
+            return opt.flat_step(flat_grads, fstate)
+
+        return _device_loop_ms(step, fstate, k=k)
+
+    model = ResNet50(ResNetConfig(num_classes=1000))
+    params, _ = model.init(jax.random.PRNGKey(0))
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.full(jnp.shape(p), 1e-4, jnp.float32), params)
+    leaf_ms, _ = run_per_leaf(params, grads)
+    flat_ms, flat_std = run_flat(params)
     n_leaves = len(jax.tree_util.tree_leaves(params))
+
+    many = {f"p{i}": jnp.full((1024,), 0.1, jnp.float32)
+            for i in range(1024)}
+    many_grads = jax.tree_util.tree_map(
+        lambda p: jnp.full_like(p, 1e-4), many)
+    many_leaf_ms, _ = run_per_leaf(many, many_grads)
+    many_flat_ms, _ = run_flat(many)
+
     _emit("fused_adam_step_ms_flat", flat_ms, "ms", leaf_ms / flat_ms,
           per_leaf_ms=round(leaf_ms, 3), n_leaves=n_leaves,
-          std_ms=round(flat_std, 3))
+          std_ms=round(flat_std, 3),
+          leaves1024_flat_ms=round(many_flat_ms, 3),
+          leaves1024_per_leaf_ms=round(many_leaf_ms, 3))
 
 
 def bench_gpt(iters=20, warmup=3):

@@ -18,14 +18,15 @@ def test_simple_distributed_runs():
 
 def test_imagenet_amp_runs_and_resumes(tmp_path):
     import imagenet_amp
-    imagenet_amp.main(["--steps", "2", "--per-device-batch", "1",
-                       "--img", "32", "--opt-level", "O2",
-                       "--ckpt-dir", str(tmp_path)])
+    first = imagenet_amp.main(["--steps", "2", "--per-device-batch", "1",
+                               "--img", "32", "--opt-level", "O2",
+                               "--ckpt-dir", str(tmp_path)])
+    assert np.isfinite(first)
     # resume picks up at step 2
     loss = imagenet_amp.main(["--steps", "1", "--per-device-batch", "1",
                               "--img", "32", "--opt-level", "O2",
                               "--ckpt-dir", str(tmp_path)])
-    assert loss == loss  # finite
+    assert np.isfinite(loss)
 
 
 def test_gpt_pretrain_runs():

@@ -338,8 +338,10 @@ def test_gpt_sequence_parallel_matches_tp():
             def inner(params, tokens):
                 loss, grads = jax.value_and_grad(
                     lambda p: model.loss(p, tokens, tokens))(params)
-                # SP: LN grads are per-rank partials; this is Megatron's
-                # separate allreduce of sequence_parallel-marked params
+                # SP: the LN custom_vjp already psums replicated-param
+                # cotangents over the tensor axis (Megatron's separate
+                # allreduce of sequence_parallel-marked params, moved into
+                # the vjp); sp_grad_sync is a retained no-op.
                 grads = model.sp_grad_sync(grads)
                 pm = lambda v: jax.lax.pmean(
                     jax.lax.pmean(v, "tensor"), "data")

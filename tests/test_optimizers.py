@@ -312,6 +312,52 @@ def test_flat_optimizer_overflow_skip_and_jit():
     assert not np.allclose(np.asarray(new_p["w"]), np.asarray(params["w"]))
 
 
+def test_flat_optimizer_persistent_flat_tier():
+    """The performance tier: params live flat across steps, grads are taken
+    w.r.t. the flat buffer through ``unflatten`` views, and ``flat_step``
+    updates everything in one fused pass. Must match the per-leaf optimizer
+    exactly, including the overflow skip."""
+    params = jax.tree_util.tree_map(jnp.asarray, _rand_tree(21))
+    data = jnp.asarray(np.random.RandomState(7).randn(5, 17), jnp.float32)
+
+    def loss_from_tree(p, x):
+        h = jnp.tanh(x @ p["w"] + p["b"])
+        return jnp.sum(h ** 2) + jnp.sum(p["emb"]["table"] ** 2)
+
+    ref_opt = opt_mod.FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+    ref_state = ref_opt.init(params)
+    rp = params
+
+    opt = opt_mod.FlatOptimizer(
+        opt_mod.FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4))
+    fstate = opt.init_flat(params)
+
+    @jax.jit
+    def flat_train_step(fstate, x):
+        g = jax.grad(lambda f: loss_from_tree(opt.unflatten(f), x))(
+            fstate.flat_params)
+        return opt.flat_step(g, fstate, grads_finite=all_finite(g))
+
+    for step in range(3):
+        x = data * (step + 1.0)
+        g = jax.grad(loss_from_tree)(rp, x)
+        rp, ref_state = ref_opt.step(g, ref_state, rp)
+        fstate = flat_train_step(fstate, x)
+
+    for a, b in zip(jax.tree_util.tree_leaves(opt.params_of(fstate)),
+                    jax.tree_util.tree_leaves(rp)):
+        # jit fuses the flat-grad path differently (reassociation noise)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    # overflow skip: a non-finite flat grad leaves the state untouched
+    before = fstate
+    bad = jnp.full_like(fstate.flat_params, jnp.nan)
+    after = opt.flat_step(bad, fstate, grads_finite=all_finite(bad))
+    np.testing.assert_array_equal(np.asarray(after.flat_params),
+                                  np.asarray(before.flat_params))
+
+
 def test_flat_optimizer_rejects_structure_change():
     params = jax.tree_util.tree_map(jnp.asarray, _rand_tree(13))
     opt = opt_mod.FlatOptimizer(opt_mod.FusedSGD(lr=0.1))

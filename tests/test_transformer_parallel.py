@@ -449,9 +449,22 @@ def test_interleaved_schedule(mesh_pp4):
             x = _stage_fn({"w": params["w"][g], "b": params["b"][g]}, x, g)
         return jnp.mean((x - t) ** 2)
 
-    loss_ref, _ = forward_backward_no_pipelining(
+    loss_ref, grads_ref = forward_backward_no_pipelining(
         full_model, (micro, targets), {"w": ws_global, "b": bs_global})
     np.testing.assert_allclose(float(loss_pipe), float(loss_ref), rtol=1e-5)
+    # grads: out_specs P("pipe") stacks per-device chunk grads, so entry
+    # [dev*2 + c] is global stage c*4 + dev — must match the sequential ref
+    gw = np.asarray(grads["w"]).reshape(4, 2, d, d)
+    gb = np.asarray(grads["b"]).reshape(4, 2, d)
+    for dev in range(4):
+        for c in range(2):
+            g = c * 4 + dev
+            np.testing.assert_allclose(
+                gw[dev, c], np.asarray(grads_ref["w"])[g],
+                rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(
+                gb[dev, c], np.asarray(grads_ref["b"])[g],
+                rtol=1e-4, atol=1e-5)
 
 
 def test_get_forward_backward_func_dispatch():
