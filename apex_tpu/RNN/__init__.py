@@ -138,8 +138,10 @@ class ApexRNN:
             p["b_ih"] = u(ks[2], (g * h,))
             p["b_hh"] = u(ks[3], (g * h,))
         if self.kind == "mlstm":
-            p["w_mih"] = u(ks[4], (h, in_size))
-            p["w_mhh"] = u(ks[5], (h, self.out_size))
+            # cells.py mLSTMRNNCell sizes the multiplicative pair by
+            # output_size so m matches w_hh's (gate, out_size) contraction
+            p["w_mih"] = u(ks[4], (self.out_size, in_size))
+            p["w_mhh"] = u(ks[5], (self.out_size, self.out_size))
         if self.proj:
             p["w_ho"] = u(ks[6], (self.out_size, h))
         return p

@@ -7,10 +7,11 @@ Linear/Conv modules, ``init_optimizer_for_pruning`` monkey-patches
 ``compute_sparse_masks`` fills the buffers with the "m4n2_1d" pattern
 (``sparse_masklib.py:37-66``: per group of 4 consecutive weights along the
 input dim, keep the 2 largest magnitudes). The permutation-search quality
-recovery (``permutation_lib.py``) targets sparse tensor-core MMA layout on
-Ampere; TPUs have no 2:4 sparse MMA, so ASP here serves the *pruning
-workflow* (train dense → mask → finetune sparse → deploy), and permutation
-search is intentionally out of scope.
+recovery (``permutation_lib.py``) lives in
+:mod:`apex_tpu.contrib.sparsity.permutation` and is enabled with
+``ASP(permute=True)`` — the search math is device-independent; only the
+Ampere-side physical relayout has no TPU meaning (masks are elementwise
+here), so the permutation expresses itself purely in mask selection.
 
 Functional shape: masks are a boolean pytree mirroring (a whitelisted
 subset of) the params — they live beside the params, ride through
@@ -73,13 +74,24 @@ def sparse_parameter_paths(params: Any, m: int = 4,
 
 
 def compute_sparse_masks(params: Any, m: int = 4, n: int = 2,
-                         whitelist: Optional[Callable] = None) -> Any:
+                         whitelist: Optional[Callable] = None,
+                         permute: bool = False, **permute_kw) -> Any:
     """Mask pytree: n:m boolean masks for whitelisted leaves, all-True for
-    the rest (``ASP.compute_sparse_masks``)."""
+    the rest (``ASP.compute_sparse_masks``).
+
+    ``permute=True`` runs the channel-permutation search
+    (:mod:`apex_tpu.contrib.sparsity.permutation`,
+    ``reference:apex/contrib/sparsity/permutation_lib.py``) per leaf and
+    selects each mask under the best found channel grouping — retained
+    magnitude is then >= the unpermuted mask's."""
     wl = whitelist or _default_whitelist
 
     def one(path, leaf):
         if wl(path, leaf, m):
+            if permute:
+                from apex_tpu.contrib.sparsity.permutation import (
+                    permuted_mn_1d_mask)
+                return permuted_mn_1d_mask(leaf, m, n, **permute_kw)
             return mn_1d_mask(leaf, m, n)
         return jnp.ones(jnp.shape(leaf), bool)
 
@@ -108,12 +120,15 @@ class ASP:
     """
 
     def __init__(self, m: int = 4, n: int = 2,
-                 whitelist: Optional[Callable] = None):
+                 whitelist: Optional[Callable] = None,
+                 permute: bool = False):
         self.m, self.n = m, n
         self.whitelist = whitelist
+        self.permute = permute
 
-    def compute_sparse_masks(self, params: Any) -> Any:
-        return compute_sparse_masks(params, self.m, self.n, self.whitelist)
+    def compute_sparse_masks(self, params: Any, **permute_kw) -> Any:
+        return compute_sparse_masks(params, self.m, self.n, self.whitelist,
+                                    permute=self.permute, **permute_kw)
 
     def prune(self, params: Any, masks: Any) -> Any:
         return apply_masks(params, masks)

@@ -289,6 +289,10 @@ class GPTModel:
         returns vocab-parallel logits (local shard) when tp>1."""
         w = _local_shard(params["embedding"]["word"]["weight"],
                          self.cfg.tensor_model_parallel_size)
+        if self.cfg.tensor_model_parallel_size == 1:
+            from apex_tpu.utils.vma import restore_invariant
+            from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+            w = restore_invariant(w, TENSOR_AXIS)
         return jax.lax.dot_general(
             x, w.astype(x.dtype), (((x.ndim - 1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)

@@ -34,6 +34,12 @@ class ResNetConfig:
     params_dtype: Any = jnp.float32
     bn_axis_name: Optional[str] = None  # "data" => SyncBN
     bn_momentum: float = 0.1
+    # apply the BN normalize at compute precision (stats stay fp32). bf16
+    # shares fp32's exponent range so this is convergence-safe (unlike the
+    # fp16 regime keep_batchnorm_fp32 guards against) and on an HBM-bound
+    # chip removes the fp32 elementwise traffic of the fwd+bwd normalize —
+    # measured 6% off the headline step, 86.7->79.8 GB/step (docs/PERF.md)
+    bn_apply_compute_dtype: bool = True
 
 
 def _conv_init(key, shape, dtype):
@@ -117,10 +123,18 @@ class Bottleneck:
 
 def _bn_apply(cfg, p, s, x, training, z=None, fuse_relu=True):
     from apex_tpu.parallel.sync_batchnorm import sync_batch_norm
+    # bf16-only: fp16's narrow exponent range is exactly what the
+    # reference's keep_batchnorm_fp32 guards against, so an fp16
+    # compute_dtype keeps the fp32 apply
+    apply_dtype = (cfg.compute_dtype
+                   if (getattr(cfg, "bn_apply_compute_dtype", False)
+                       and jnp.dtype(cfg.compute_dtype) == jnp.bfloat16)
+                   else None)
     return sync_batch_norm(
         x, p["weight"], p["bias"], s, training=training,
         momentum=cfg.bn_momentum, channel_axis=-1,
-        axis_name=cfg.bn_axis_name, z=z, fuse_relu=fuse_relu)
+        axis_name=cfg.bn_axis_name, z=z, fuse_relu=fuse_relu,
+        apply_dtype=apply_dtype)
 
 
 class ResNet50:

@@ -97,9 +97,28 @@ class _DistributedFusedBase(OptimizerBase):
                                  tiled=True)
         return g / self._dp(lay)
 
-    def _gather_params(self, master: jnp.ndarray, lay: FlatLayout) -> Any:
+    def _gather_params(self, master: jnp.ndarray, lay: FlatLayout,
+                       like: Any = None) -> Any:
         flat = _all_gather_flat(master, self.axis_name, axis=0)
-        return unravel(flat, lay)
+        new_params = unravel(flat, lay)
+        if like is None:
+            return new_params
+        # the flat master mixes leaves with different varying-axes sets, so
+        # every unraveled leaf inherits the union (e.g. an LN weight comes
+        # back typed tensor-varying next to TP-sharded leaves). Replicated-
+        # by-construction leaves are value-identical across those extra
+        # axes, so a pmean is a value identity that restores each leaf's
+        # original type (required by the caller's out_specs).
+
+        from apex_tpu.utils.vma import leaf_vma
+
+        def rec(n, p):
+            extra = leaf_vma(n) - leaf_vma(p)
+            if extra:
+                n = jax.lax.pmean(n, tuple(sorted(extra)))
+            return n
+
+        return jax.tree_util.tree_map(rec, new_params, like)
 
 
 class DistributedFusedAdam(_DistributedFusedBase):
@@ -156,7 +175,7 @@ class DistributedFusedAdam(_DistributedFusedBase):
         if self.adam_w_mode:
             update = update + wd * p32
         new_master = p32 - lr * update
-        new_params = self._gather_params(new_master, lay)
+        new_params = self._gather_params(new_master, lay, like=params)
         return new_params, ZeroAdamState(step=t, master=new_master,
                                          exp_avg=m, exp_avg_sq=v)
 
@@ -235,6 +254,6 @@ class DistributedFusedLAMB(_DistributedFusedBase):
             ratio = jnp.where((p_norm > 0) & (u_norm > 0),
                               p_norm / u_norm, 1.0)
         new_master = p32 - lr * jnp.take(ratio, seg) * update
-        new_params = self._gather_params(new_master, lay)
+        new_params = self._gather_params(new_master, lay, like=params)
         return new_params, ZeroLambState(step=t, master=new_master,
                                          exp_avg=m, exp_avg_sq=v)

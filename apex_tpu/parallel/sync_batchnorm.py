@@ -60,6 +60,7 @@ def sync_batch_norm(
     axis_index_groups: Optional[Sequence[Sequence[int]]] = None,
     z: Optional[jnp.ndarray] = None,
     fuse_relu: bool = False,
+    apply_dtype: Optional[Any] = None,
 ) -> Tuple[jnp.ndarray, BatchNormState]:
     """Returns ``(out, new_state)``.
 
@@ -67,6 +68,15 @@ def sync_batch_norm(
     ``axis_name`` is None this is ordinary BN (the single-process fallback of
     ``optimized_sync_batchnorm.py:70``). ``z`` is the pre-activation residual
     added before the optional fused ReLU.
+
+    ``apply_dtype``: dtype of the per-element normalize/scale/shift (and its
+    backward). Default ``None`` = fp32, the reference ``keep_batchnorm_fp32``
+    semantics. Pass the input dtype (e.g. bf16) to fold the normalization
+    into a per-channel ``x * a + b`` computed from fp32 statistics — the
+    statistics reductions stay fp32, only the O(N*H*W) apply runs at input
+    precision. On an HBM-bound step this halves the BN apply/backward
+    traffic; bf16 shares fp32's exponent range, so the fp16-era divergence
+    risk ``keep_batchnorm_fp32`` guards against does not apply.
     """
     c_ax = channel_axis % x.ndim
     red = _reduce_axes(x, c_ax)
@@ -101,6 +111,20 @@ def sync_batch_norm(
         new_state = state
 
     inv = jax.lax.rsqrt(var + eps)
+    if apply_dtype is not None and jnp.dtype(apply_dtype) != jnp.float32:
+        # per-channel affine folded in fp32, applied at input precision
+        a = inv if weight is None else inv * weight.astype(jnp.float32)
+        b = -mean * a
+        if bias is not None:
+            b = b + bias.astype(jnp.float32)
+        a = a.astype(apply_dtype).reshape(stat_shape)
+        b = b.astype(apply_dtype).reshape(stat_shape)
+        out = x.astype(apply_dtype) * a + b
+        if z is not None:
+            out = out + z.astype(apply_dtype)
+        if fuse_relu:
+            out = jax.nn.relu(out)
+        return out.astype(x.dtype), new_state
     out = (xf - mean.reshape(stat_shape)) * inv.reshape(stat_shape)
     if weight is not None:
         out = out * weight.astype(jnp.float32).reshape(stat_shape)

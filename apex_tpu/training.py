@@ -18,6 +18,8 @@ from jax.sharding import PartitionSpec as P
 
 from apex_tpu.config import TrainConfig
 from apex_tpu.optimizers import AdamState
+from apex_tpu.optimizers.distributed_fused import (_DistributedFusedBase,
+                                                   ZeroAdamState)
 from apex_tpu.transformer.amp import GradScaler
 from apex_tpu.transformer.pipeline_parallel import (
     forward_backward_pipelining_without_interleaving)
@@ -48,6 +50,11 @@ class GPTHybridTrainer:
         self.pp = cfg.parallel.pipeline_model_parallel_size
         self.model = cfg.build_model()
         self.opt = cfg.build_optimizer()
+        # ZeRO (OptimizerConfig.zero): DistributedFused* shards optimizer
+        # state 1/dp over the data axis — its init/step run inside the
+        # mesh'd region and its grad comm is the reduce_scatter itself
+        # (reference:apex/contrib/optimizers/distributed_fused_adam.py:409)
+        self.is_zero = isinstance(self.opt, _DistributedFusedBase)
         self.scaler = GradScaler(init_scale=init_scale)
         _, self.split_params = self.model.stage_fn(self.pp)
 
@@ -57,8 +64,27 @@ class GPTHybridTrainer:
         stage_stack = self.split_params(params)
         shared = {"embedding": params["embedding"],
                   "final_ln": params["final_ln"]}
-        opt_state = self.opt.init((stage_stack, shared))
+        if self.is_zero:
+            sspec = self.stage_specs(stage_stack)
+            opt = self.opt
+
+            def init_inner(stage_stack, shared):
+                return opt.init((stage_stack, shared))
+
+            opt_state = jax.jit(shard_map(
+                init_inner, mesh=self.mesh,
+                in_specs=(sspec, self.shared_specs),
+                out_specs=self._zero_state_spec()))(stage_stack, shared)
+        else:
+            opt_state = self.opt.init((stage_stack, shared))
         return stage_stack, shared, opt_state, self.scaler.init()
+
+    def _zero_state_spec(self):
+        # every device owns a distinct flat shard (its pipe stage x its
+        # tensor slice x its 1/dp chunk): fully sharded along dim 0
+        flat = P(("pipe", "data", "tensor"))
+        return ZeroAdamState(step=P(), master=flat, exp_avg=flat,
+                             exp_avg_sq=flat)
 
     # -- shardings --------------------------------------------------------
     @staticmethod
@@ -75,9 +101,9 @@ class GPTHybridTrainer:
 
     def state_specs(self, stage_stack):
         specs_p = (self.stage_specs(stage_stack), self.shared_specs)
-        return (specs_p[0], specs_p[1],
-                AdamState(step=P(), exp_avg=specs_p, exp_avg_sq=specs_p),
-                P())
+        ospec = (self._zero_state_spec() if self.is_zero else
+                 AdamState(step=P(), exp_avg=specs_p, exp_avg_sq=specs_p))
+        return (specs_p[0], specs_p[1], ospec, P())
 
     # -- the step ---------------------------------------------------------
     def train_step(self, stage_stack, shared, opt_state, ls, tokens,
@@ -100,9 +126,21 @@ class GPTHybridTrainer:
                     shared_params=vary(shared), embed_fn=embed_fn,
                     grad_scale=ls.loss_scale)
             grads = (jax.tree_util.tree_map(lambda g: g[None], sg), shg)
-            grads = jax.tree_util.tree_map(
-                lambda g: jax.lax.pmean(g, "data"), grads)
-            finite = scaler.all_finite_synced(grads)
+            if not self.is_zero:
+                grads = jax.tree_util.tree_map(
+                    lambda g: jax.lax.pmean(g, "data"), grads)
+            # (ZeRO: the optimizer's psum_scatter/dp IS the DDP mean —
+            # reduce_scatter replaces the allreduce, the ZeRO comm win)
+            if self.is_zero:
+                # grads are still per-data-rank here, so the skip decision
+                # must sync over data too (the reference's distributed
+                # optimizer allreduces found_inf over the world,
+                # distributed_fused_adam.py:409 region)
+                from apex_tpu.amp.scaler import all_finite
+                finite = all_finite(
+                    grads, axis_names=(*scaler.model_parallel_axes, "data"))
+            else:
+                finite = scaler.all_finite_synced(grads)
             new_ls = scaler.update(ls, finite)
             new_p, new_s = opt.step(grads, opt_state,
                                     (stage_stack, shared),

@@ -41,7 +41,7 @@ import jax.numpy as jnp
 from apex_tpu.transformer.parallel_state import PIPE_AXIS
 from apex_tpu.utils.vma import cast_to_vma
 from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
-    rotate_forward)
+    rotate_backward, rotate_forward)
 
 
 
@@ -271,6 +271,223 @@ def pipelined_apply(
 
 
 # ---------------------------------------------------------------------------
+# memory-efficient 1F1B: hand-driven vjp inside the tick scan
+# ---------------------------------------------------------------------------
+
+from apex_tpu.utils.vma import leaf_vma as _leaf_vma
+
+
+def _fixed_point_vma(tick, init, max_iters: int = 8):
+    """Per-LEAF varying-axes fixed point for a scan carry: each leaf keeps
+    the minimal axes the body actually varies it over (a global union would
+    over-vary e.g. tensor-replicated LN grad accumulators, breaking the
+    caller's out_specs)."""
+    vma_tree = jax.tree_util.tree_map(_leaf_vma, init)
+    for _ in range(max_iters):
+        init_c = jax.tree_util.tree_map(cast_to_vma, init, vma_tree)
+        out = jax.eval_shape(lambda c: tick(c, jnp.asarray(0))[0], init_c)
+        new_tree = jax.tree_util.tree_map(
+            lambda v, o: v | _leaf_vma(o), vma_tree, out)
+        if jax.tree_util.tree_all(jax.tree_util.tree_map(
+                lambda a, b: a == b, vma_tree, new_tree)):
+            break
+        vma_tree = new_tree
+    return vma_tree
+
+
+def _onef1b_fwd_bwd(stage_fn, loss_fn, params, microbatches, remat,
+                    grad_scale, shared_params=None, embed_fn=None):
+    """True-1F1B-memory pipelined forward+backward (single chunk per stage).
+
+    The AD-through-the-tick-scan path (:func:`pipelined_apply`) stores one
+    residual per tick — O(M + S) activations per device. The reference's
+    1F1B exists precisely to avoid that
+    (``reference:apex/transformer/pipeline_parallel/schedules/
+    fwd_bwd_pipelining_without_interleaving.py:155-345`` holds at most
+    O(pp) microbatches in flight; ``free_output_tensor``,
+    ``common.py:198-249``, frees each output the moment its consumer is
+    done). This driver reproduces that bound the SPMD way: ONE scan whose
+    tick does one forward microbatch AND one backward microbatch per
+    device, with the backward built from an explicit ``jax.vjp`` that
+    *recomputes* the stage forward (the reference's
+    activation-checkpoint + free trade). The scan itself is never
+    differentiated, so its carry — not AD residuals — is the whole
+    activation memory:
+
+    - ``saved``: 2S input-activation slots (the in-flight window; at stage
+      d only ``2(S-d)-1`` are live, slot reuse is mod-2S),
+    - one in-transit activation + one in-transit cotangent,
+    - the fp32 grad accumulators.
+
+    Backward of microbatch m at stage d runs at tick ``m + 2S - 1 - d``;
+    total ticks ``M + 2S - 2 + 1``. The cotangent for (m, d) arrives from
+    stage d+1's ``dx`` of the previous tick via the reverse rotation; the
+    last stage seeds from the loss vjp. Bubble ticks carry exactly-zero
+    cotangents (vjp is linear in the seed), so no masking of the grad
+    accumulation is needed beyond the loss/seed masks.
+
+    Compiled temp memory is O(1) in M — asserted by
+    ``tests/test_pipeline_memory.py::test_memory_efficient_1f1b_is_O1_in_microbatches``.
+    """
+    if embed_fn is not None and shared_params is None:
+        raise ValueError(
+            "embed_fn takes (shared_params, microbatch); pass the embedding "
+            "parameters via shared_params so they are differentiated")
+    S = jax.lax.axis_size(PIPE_AXIS)
+    rank = jax.lax.axis_index(PIPE_AXIS)
+    M = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
+    B = 2 * S
+    T = M + 2 * S - 1
+
+    f = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def mb_at(m):
+        return jax.tree_util.tree_map(
+            lambda v: jax.lax.dynamic_index_in_dim(
+                v, jnp.clip(m, 0, M - 1), 0, keepdims=False), microbatches)
+
+    # activation shape/dtype (after embed, if any)
+    if embed_fn is None:
+        if not isinstance(microbatches, jnp.ndarray):
+            raise ValueError("pytree microbatches require embed_fn")
+        act_shape, act_dtype = microbatches.shape[1:], microbatches.dtype
+    else:
+        act_aval = jax.eval_shape(
+            lambda sh, mb: embed_fn(sh, mb), shared_params, mb_at(0))
+        act_shape, act_dtype = act_aval.shape, act_aval.dtype
+
+    def first_stage_input(shared, mb):
+        if embed_fn is not None:
+            return embed_fn(shared, mb).astype(act_dtype)
+        return mb.astype(act_dtype)
+
+    def stage_and_loss(p, shared, xb, mb, m):
+        """Uniform composite: stage 0 re-derives its input from the
+        microbatch (so embed params are differentiated), other stages use
+        the saved input; the loss head is evaluated everywhere but seeded
+        only on the last stage. ``mb`` must already be chained into the
+        tick's collective order (see the barriers in ``tick``)."""
+        x_in = jnp.where(rank == 0, first_stage_input(shared, mb), xb)
+        y = f(p, x_in, rank)
+        if shared_params is None:
+            l = loss_fn(y, m)
+        else:
+            l = loss_fn(shared, y, m)
+        return y.astype(act_dtype), l
+
+    zero_act = jnp.zeros(act_shape, act_dtype)
+    f32 = jnp.float32
+
+    def tick(carry, t):
+        act_in, cot_in, saved, acc_g, acc_sg, loss_sum = carry
+
+        # ---- forward sub-tick: microbatch m_f enters this stage ----
+        m_f = t - rank
+        # the embed's collectives depend only on loop-invariants, so they
+        # would float free of the tick's collective order — chain the
+        # microbatch slice behind the carried activation first (see the
+        # ordering note below)
+        mb_f, act_in = jax.lax.optimization_barrier((mb_at(m_f), act_in))
+        x_in = jnp.where(rank == 0,
+                         first_stage_input(shared_params, mb_f), act_in)
+        y = f(params, x_in, rank)
+        # slot reuse is safe even for bubble writes: a write at m_f can
+        # only collide with a pending read at m_b if 2S | (m_f - m_b) =
+        # 2S - 1 - 2*rank, which is odd — impossible
+        saved = jax.lax.dynamic_update_index_in_dim(
+            saved, x_in, jnp.mod(m_f, B), 0)
+        act_next = rotate_forward(y.astype(act_dtype))
+
+        # ---- backward sub-tick: microbatch m_b leaves this stage ----
+        m_b = t - 2 * S + 1 + rank
+        valid_b = jnp.logical_and(m_b >= 0, m_b < M)
+        # sequence the tick's collectives: the forward rotation, the vjp's
+        # internal psums, and the backward rotation are data-independent,
+        # and XLA's CPU thunk runtime may run independent collectives
+        # concurrently per device — with devices arriving in different
+        # orders the rendezvous can cross-match and hit the 40s abort. The
+        # barrier threads act_next into the backward half so every device
+        # issues the collectives in one global order. (On TPU the static
+        # schedule makes this a no-op.)
+        act_next, saved = jax.lax.optimization_barrier((act_next, saved))
+        xb = jax.lax.dynamic_index_in_dim(saved, jnp.mod(m_b, B), 0,
+                                          keepdims=False)
+        xb, mb_b = jax.lax.optimization_barrier((xb, mb_at(m_b)))
+        (y_b, l_b), vjp_fn = jax.vjp(
+            lambda p, sh, x: stage_and_loss(p, sh, x, mb_b, m_b),
+            params, shared_params, xb)
+        is_last = rank == S - 1
+        dy = jnp.where(is_last, jnp.zeros_like(cot_in), cot_in)
+        dl = jnp.where(jnp.logical_and(is_last, valid_b),
+                       jnp.asarray(grad_scale, f32) / M,
+                       jnp.asarray(0.0, f32))
+        # seed types must match the primal outputs' varying axes exactly
+        # (e.g. data-varying under the DDP pattern)
+        dy = cast_to_vma(dy.astype(y_b.dtype),
+                         getattr(jax.typeof(y_b), "vma", frozenset()))
+        dl = cast_to_vma(dl.astype(l_b.dtype),
+                         getattr(jax.typeof(l_b), "vma", frozenset()))
+        dparams, dshared, dxb = vjp_fn((dy, dl))
+        acc_g = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(f32), acc_g, dparams)
+        if shared_params is not None:
+            acc_sg = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(f32), acc_sg, dshared)
+        loss_sum = loss_sum + jnp.where(
+            jnp.logical_and(is_last, valid_b), l_b.astype(f32), 0.0)
+        cot_next = rotate_backward(dxb.astype(act_dtype))
+        # close the chain: the next tick's forward rotation must not start
+        # until this tick's backward rotation is issued (see barrier above)
+        act_next, cot_next = jax.lax.optimization_barrier(
+            (act_next, cot_next))
+
+        return (act_next, cot_next, saved, acc_g, acc_sg, loss_sum), None
+
+    zeros_g = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(jnp.shape(p), f32), params)
+    zeros_sg = (None if shared_params is None else jax.tree_util.tree_map(
+        lambda p: jnp.zeros(jnp.shape(p), f32), shared_params))
+    init = (zero_act, zero_act, jnp.zeros((B,) + act_shape, act_dtype),
+            zeros_g, zeros_sg, jnp.asarray(0.0, f32))
+
+    # fixed-point each carry leaf's varying-axes set (the stage body may
+    # add axes, e.g. TP makes activations tensor-varying, while LN grad
+    # accumulators must stay tensor-replicated)
+    vma_tree = _fixed_point_vma(tick, init)
+
+    def tick_stable(carry, t):
+        new_carry, _ = tick(carry, t)
+        return jax.tree_util.tree_map(cast_to_vma, new_carry, vma_tree), None
+
+    (
+        _, _, _, acc_g, acc_sg, loss_sum
+    ), _ = jax.lax.scan(
+        tick_stable, jax.tree_util.tree_map(cast_to_vma, init, vma_tree),
+        jnp.arange(T))
+
+    mean_loss = jax.lax.psum(
+        jnp.where(rank == S - 1, loss_sum / M, 0.0), PIPE_AXIS)
+    inv_scale = 1.0 / jnp.asarray(grad_scale, f32)
+    stage_grads = jax.tree_util.tree_map(lambda g: g * inv_scale, acc_g)
+    if shared_params is None:
+        return mean_loss, stage_grads
+
+    # shared_params enter pipe-INVARIANT, so the vjp's type reconciliation
+    # already psums their per-tick cotangent across stages — every rank
+    # accumulates the replicated total. If a carry cast left the
+    # accumulator pipe-varying-TYPED, psum/S restores the invariant type
+    # without double counting the S identical copies.
+    def _finalize_shared(g):
+        g = g * inv_scale
+        if PIPE_AXIS in _leaf_vma(g):
+            g = jax.lax.psum(g, PIPE_AXIS) / S
+        return g
+
+    shared_grads = jax.tree_util.tree_map(_finalize_shared, acc_sg)
+    return mean_loss, (stage_grads, shared_grads)
+
+
+# ---------------------------------------------------------------------------
 # pipelined schedules (loss + grads)
 # ---------------------------------------------------------------------------
 
@@ -351,10 +568,12 @@ def forward_backward_pipelining_without_interleaving(
     grad_scale: Any = 1.0,
     shared_params: Any = None,
     embed_fn: Optional[Callable] = None,
+    memory_efficient: bool = True,
 ):
-    """Pipelined schedule, output-equivalent to 1F1B
-    (``fwd_bwd_pipelining_without_interleaving.py:155-345``); see
-    ``pipelined_apply`` for the memory profile vs true 1F1B.
+    """Pipelined schedule matching 1F1B
+    (``fwd_bwd_pipelining_without_interleaving.py:155-345``) in output AND —
+    by default — in its O(pp) activation-memory bound (see
+    :func:`_onef1b_fwd_bwd`).
 
     ``forward_step_func(stage_params, x, stage_index) -> y`` is the uniform
     stage body; ``loss_fn(final_output, microbatch_index) -> scalar``.
@@ -364,7 +583,15 @@ def forward_backward_pipelining_without_interleaving(
     With ``shared_params``/``embed_fn`` (pipelined embedding + tied head, see
     ``_pipelined_fwd_bwd``), ``loss_fn(shared, y, m)`` and grads are
     ``(stage_grads, shared_grads)`` with shared_grads psummed over ``pipe``.
+
+    ``memory_efficient=False`` selects the AD-through-the-tick-scan driver
+    (O(M + pp) per-tick residuals; cheaper per step at small M since the
+    forward is not recomputed).
     """
+    if memory_efficient and not forward_only:
+        return _onef1b_fwd_bwd(
+            forward_step_func, loss_fn, params, batch, remat, grad_scale,
+            shared_params=shared_params, embed_fn=embed_fn)
     chunked = jax.tree_util.tree_map(lambda p: p[None], params)
     loss, grads = _pipelined_fwd_bwd(
         forward_step_func, loss_fn, chunked, batch, 1, forward_only, remat,

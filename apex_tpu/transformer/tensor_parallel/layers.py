@@ -172,6 +172,13 @@ class ColumnParallelLinear:
             out = gather_from_tensor_model_parallel_region(out)
             if b is not None:
                 b = gather_from_tensor_model_parallel_region(b)
+        elif self.world_size == 1 and self.gather_output:
+            # size-1 axis: restore the invariant type the gather would
+            # (a P('tensor')-spec'd weight leaves these tensor-varying)
+            from apex_tpu.utils.vma import restore_invariant
+            out = restore_invariant(out, TENSOR_AXIS)
+            if b is not None:
+                b = restore_invariant(b, TENSOR_AXIS)
         return out, b
 
 
@@ -243,7 +250,13 @@ class RowParallelLinear:
             else:
                 out = reduce_from_tensor_model_parallel_region(partial)
         else:
-            out = partial
+            # a P('tensor')-spec'd weight leaves `partial` typed
+            # tensor-varying even on a size-1 axis; restore the invariant
+            # type the tp>1 psum would (value identity)
+            from apex_tpu.utils.vma import restore_invariant
+            out = restore_invariant(partial, TENSOR_AXIS)
+            if b is not None:
+                b = restore_invariant(b, TENSOR_AXIS)
         return out, b
 
 
@@ -274,7 +287,10 @@ class VocabParallelEmbedding:
     def __call__(self, params: dict, ids: jnp.ndarray) -> jnp.ndarray:
         w = _local_shard(params["weight"], self.world_size)
         if self.world_size == 1:
-            return jnp.take(w, ids, axis=0)
+            # a P('tensor')-spec'd weight is typed tensor-varying even on a
+            # size-1 axis; restore the invariant type the tp>1 psum would
+            from apex_tpu.utils.vma import restore_invariant
+            return jnp.take(restore_invariant(w, TENSOR_AXIS), ids, axis=0)
         per = self.num_embeddings_per_partition
         start = jax.lax.axis_index(TENSOR_AXIS) * per
         # vocab-range mask (:221-239)

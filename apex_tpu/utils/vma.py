@@ -16,7 +16,29 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["cast_to_vma", "scan_stable_vma", "invariant_all_gather",
-           "reconcile_cotangent"]
+           "reconcile_cotangent", "restore_invariant", "leaf_vma"]
+
+
+def leaf_vma(x) -> frozenset:
+    """The varying-manual-axes set of a value (empty outside shard_map)."""
+    return getattr(jax.typeof(x), "vma", None) or frozenset()
+
+
+def restore_invariant(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Restore the device-INVARIANT type of a value that is replicated by
+    construction but typed varying over ``axis_name``.
+
+    The canonical case is a degenerate sharded axis: a param with in_spec
+    ``P('tensor')`` is typed tensor-varying even when the axis has size 1,
+    and a ``world_size == 1`` fast path that skips its closing collective
+    (e.g. :class:`VocabParallelEmbedding`'s lookup) leaks that type into
+    everything downstream, breaking replicated out_specs. The psum over the
+    size-1 axis is a value identity that fixes the type; outside
+    ``shard_map`` (empty vma) this is a no-op.
+    """
+    if axis_name in leaf_vma(x):
+        return jax.lax.psum(x, axis_name)
+    return x
 
 
 def reconcile_cotangent(ct: jnp.ndarray, primal: jnp.ndarray) -> jnp.ndarray:
@@ -32,8 +54,8 @@ def reconcile_cotangent(ct: jnp.ndarray, primal: jnp.ndarray) -> jnp.ndarray:
     the cotangent lacks are pvaried (type-only, value-preserving). No-op
     when the types already agree.
     """
-    ct_vma = getattr(jax.typeof(ct), "vma", frozenset()) or frozenset()
-    p_vma = getattr(jax.typeof(primal), "vma", frozenset()) or frozenset()
+    ct_vma = leaf_vma(ct)
+    p_vma = leaf_vma(primal)
     extra = tuple(sorted(ct_vma - p_vma))
     if extra:
         ct = jax.lax.psum(ct, extra)

@@ -1,0 +1,165 @@
+"""Ablation attribution for the headline RN50 train step (docs/PERF.md).
+
+Times the full bench-identical step, then a ladder of ablations that each
+remove one cost component; the deltas attribute the step time. Every
+ablation threads a scalar that depends on ALL the compute it claims to
+measure, so XLA cannot dead-code-eliminate the work.
+
+Run on the bench chip:  python scripts/profile_rn50.py
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.amp.scaler import DynamicLossScale, all_finite
+from apex_tpu.models import ResNet50, ResNetConfig
+from apex_tpu.optimizers import FlatOptimizer, FusedSGD
+from apex_tpu.utils.timers import device_fence
+
+
+def timeit(fn, args, iters=30, warmup=5, chunk=10):
+    out = args
+    for _ in range(warmup):
+        out = fn(*out)
+    device_fence(out)
+    t0 = time.perf_counter()
+    device_fence(out)
+    rtt = time.perf_counter() - t0
+    per = []
+    for _ in range(max(1, iters // chunk)):
+        t0 = time.perf_counter()
+        for _ in range(chunk):
+            out = fn(*out)
+        device_fence(out)
+        per.append(max(time.perf_counter() - t0 - rtt, 1e-9) / chunk)
+    return float(np.mean(per) * 1e3), float(np.std(per) * 1e3)
+
+
+def tree_sum(t):
+    return sum(jnp.sum(l.astype(jnp.float32))
+               for l in jax.tree_util.tree_leaves(t))
+
+
+def main(bn_compute_apply=True):
+    batch, img = 256, 224
+    cfg = ResNetConfig(num_classes=1000, compute_dtype=jnp.bfloat16,
+                       bn_apply_compute_dtype=bn_compute_apply)
+    model = ResNet50(cfg)
+    params, bn_state = model.init(jax.random.PRNGKey(0))
+    opt = FlatOptimizer(FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4))
+    opt_state = opt.init(params)
+    scaler = DynamicLossScale(init_scale=2.0 ** 12)
+    ls = scaler.init()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(batch, img, img, 3), jnp.bfloat16)
+    labels = jnp.asarray(rng.randint(0, 1000, batch))
+    copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)
+
+    def loss_fn(params, bn_state, scale, training=True):
+        logits, new_bn = model(params, bn_state, x, training=training)
+        onehot = jax.nn.one_hot(labels, 1000)
+        loss = -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
+        return loss * scale, (loss, new_bn)
+
+    results = {}
+
+    # 1. full bench-identical step
+    @(lambda f: jax.jit(f, donate_argnums=(0, 1, 2, 3)))
+    def full_step(params, bn_state, opt_state, ls):
+        grads, (loss, new_bn) = jax.grad(loss_fn, has_aux=True)(
+            params, bn_state, ls.loss_scale)
+        finite = all_finite(grads)
+        new_ls = scaler.update(ls, finite)
+        params, opt_state = opt.step(grads, opt_state, params,
+                                     grads_finite=finite,
+                                     scale=1.0 / ls.loss_scale)
+        return params, new_bn, opt_state, new_ls
+
+    c = full_step.lower(params, bn_state, opt_state, ls).compile()
+    results["full_step"] = timeit(
+        c, (copy(params), copy(bn_state), copy(opt_state), copy(ls)))
+
+    # 2. fwd+bwd only: all grads kept live via a full-tree reduction
+    @jax.jit
+    def fwd_bwd(params, bn_state, acc):
+        grads, (loss, new_bn) = jax.grad(loss_fn, has_aux=True)(
+            params, bn_state, 1.0)
+        return params, new_bn, acc * 0.0 + tree_sum(grads) + loss
+
+    results["fwd_bwd_only"] = timeit(
+        fwd_bwd, (params, bn_state, jnp.float32(0)))
+
+    # 3. fwd only, training-mode BN (batch stats computed)
+    @jax.jit
+    def fwd_train(params, bn_state, acc):
+        _, (loss, new_bn) = loss_fn(params, bn_state, 1.0)
+        return params, new_bn, acc * 0.0 + loss
+
+    results["fwd_train"] = timeit(
+        fwd_train, (params, bn_state, jnp.float32(0)))
+
+    # 4. fwd only, eval-mode BN (running stats; no batch reductions)
+    @jax.jit
+    def fwd_eval(params, bn_state, acc):
+        _, (loss, _) = loss_fn(params, bn_state, 1.0, training=False)
+        return params, bn_state, acc * 0.0 + loss
+
+    results["fwd_eval"] = timeit(
+        fwd_eval, (params, bn_state, jnp.float32(0)))
+
+    # 5. fwd+bwd with eval-mode BN — batch-stat cost inside the whole
+    #    differentiated program
+    @jax.jit
+    def fwd_bwd_eval(params, bn_state, acc):
+        def lf(p):
+            s, _ = loss_fn(p, bn_state, 1.0, training=False)
+            return s
+        grads = jax.grad(lf)(params)
+        return params, bn_state, acc * 0.0 + tree_sum(grads)
+
+    results["fwd_bwd_evalbn"] = timeit(
+        fwd_bwd_eval, (params, bn_state, jnp.float32(0)))
+
+    # 6. optimizer+scaler alone on realistic grads
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.full(jnp.shape(p), 1e-4, jnp.float32), params)
+
+    @(lambda f: jax.jit(f, donate_argnums=(0, 1, 2)))
+    def opt_only(params, opt_state, ls):
+        finite = all_finite(grads)
+        new_ls = scaler.update(ls, finite)
+        params, opt_state = opt.step(grads, opt_state, params,
+                                     grads_finite=finite,
+                                     scale=1.0 / ls.loss_scale)
+        return params, opt_state, new_ls
+
+    results["opt_scaler_only"] = timeit(
+        opt_only, (copy(params), copy(opt_state), copy(ls)))
+
+    for k, (ms, std) in results.items():
+        print(json.dumps({"phase": k, "bn_compute_apply": bn_compute_apply,
+                          "ms": round(ms, 3), "std": round(std, 3)}),
+              flush=True)
+
+    try:
+        ca = c.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        out = {k: float(v) for k, v in ca.items()
+               if k in ("flops", "bytes accessed", "optimal_seconds")}
+        print(json.dumps({"cost_analysis": out}))
+    except Exception as e:
+        print("cost_analysis failed:", e)
+
+
+if __name__ == "__main__":
+    import sys
+    if "--ab" in sys.argv:
+        main(bn_compute_apply=False)
+        main(bn_compute_apply=True)
+    else:
+        main()

@@ -228,3 +228,42 @@ def test_syncbn_track_running_stats_false():
     np.testing.assert_array_equal(np.asarray(st.running_mean),
                                   np.asarray(state.running_mean))
     assert int(st.num_batches_tracked) == 0
+
+
+def test_syncbn_apply_dtype_matches_fp32_path():
+    """apply_dtype folds the normalize to a per-channel x*a+b at input
+    precision; statistics stay fp32, so outputs match the fp32 path to
+    bf16 rounding and the running stats match exactly (docs/PERF.md)."""
+    from apex_tpu.parallel.sync_batchnorm import sync_batch_norm
+
+    rng = np.random.RandomState(11)
+    x = jnp.asarray(rng.randn(64, 8) * 2 + 1, jnp.bfloat16)
+    w = jnp.asarray(rng.rand(8) + 0.5, jnp.float32)
+    b = jnp.asarray(rng.randn(8), jnp.float32)
+    z = jnp.asarray(rng.randn(64, 8), jnp.bfloat16)
+    _, state = SyncBatchNorm(8, channel_axis=-1).init()
+
+    ref, st_ref = sync_batch_norm(x, w, b, state, training=True,
+                                  channel_axis=-1, z=z, fuse_relu=True)
+    fast, st_fast = sync_batch_norm(x, w, b, state, training=True,
+                                    channel_axis=-1, z=z, fuse_relu=True,
+                                    apply_dtype=jnp.bfloat16)
+    assert fast.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(fast, np.float32), np.asarray(ref, np.float32),
+        rtol=0.05, atol=0.05)
+    # statistics are identical — only the elementwise apply changed
+    np.testing.assert_array_equal(np.asarray(st_fast.running_mean),
+                                  np.asarray(st_ref.running_mean))
+    np.testing.assert_array_equal(np.asarray(st_fast.running_var),
+                                  np.asarray(st_ref.running_var))
+
+    # gradients flow and stay finite through the folded path
+    def loss(x):
+        out, _ = sync_batch_norm(x, w, b, state, training=True,
+                                 channel_axis=-1, apply_dtype=jnp.bfloat16)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss)(x)
+    assert g.dtype == jnp.bfloat16
+    assert bool(jnp.all(jnp.isfinite(g.astype(jnp.float32))))

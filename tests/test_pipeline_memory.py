@@ -1,13 +1,16 @@
 """Pipeline-schedule backward memory accounting.
 
-The reference's 1F1B exists to bound in-flight activations
-(``reference:apex/transformer/pipeline_parallel/schedules/
-fwd_bwd_pipelining_without_interleaving.py:155-345``). Our traced-scan
-schedule stores per-tick residuals instead (O(M + L) ticks); these tests
-pin down that profile with XLA's compiled memory analysis on the CPU
-backend and assert the bound ``remat=True`` guarantees: the per-microbatch
-residual cost collapses to the scan carry (one activation per chunk),
-intra-stage activations being recomputed.
+The reference's 1F1B exists to bound in-flight activations at O(pp)
+microbatches (``reference:apex/transformer/pipeline_parallel/schedules/
+fwd_bwd_pipelining_without_interleaving.py:155-345``,
+``free_output_tensor`` at ``common.py:198-249``). The default
+``memory_efficient=True`` schedule reproduces that bound with a
+hand-driven vjp inside the tick scan — asserted here as O(1)-in-M
+compiled temp memory. The AD-through-the-scan driver
+(``memory_efficient=False``) keeps its documented O(M + L) per-tick
+residual profile, with ``remat=True`` collapsing each tick's residual to
+the carry; both profiles are pinned with XLA's compiled memory analysis
+on the CPU backend.
 """
 
 import jax
@@ -42,7 +45,7 @@ def _stage_fn(p, x, s):
     return x
 
 
-def _temp_bytes(mesh, M, remat):
+def _temp_bytes(mesh, M, remat, memory_efficient):
     rng = np.random.RandomState(0)
     ws = jnp.asarray(rng.randn(PP, D, D) * 0.1, jnp.float32)
     micro = jnp.asarray(rng.randn(M, MB, D), jnp.float32)
@@ -51,7 +54,8 @@ def _temp_bytes(mesh, M, remat):
         def inner(ws):
             loss, grads = forward_backward_pipelining_without_interleaving(
                 _stage_fn, micro, {"w": ws[0]},
-                loss_fn=lambda y, m: jnp.mean(y ** 2), remat=remat)
+                loss_fn=lambda y, m: jnp.mean(y ** 2), remat=remat,
+                memory_efficient=memory_efficient)
             return loss, grads
         return shard_map(inner, mesh=mesh, in_specs=(P("pipe"),),
                          out_specs=(P(), {"w": P("pipe")}))(ws)
@@ -60,13 +64,46 @@ def _temp_bytes(mesh, M, remat):
     return compiled.memory_analysis().temp_size_in_bytes
 
 
-def test_backward_memory_is_linear_in_microbatches(mesh):
-    """Honest bound: residual memory grows ~linearly with M (ticks), unlike
-    true 1F1B's O(pp). This is the documented profile, asserted so a future
-    schedule rewrite that achieves 1F1B memory shows up as a (good)
-    failure."""
-    t8 = _temp_bytes(mesh, 8, remat=False)
-    t32 = _temp_bytes(mesh, 32, remat=False)
+def test_memory_efficient_1f1b_is_O1_in_microbatches(mesh):
+    """The default schedule holds O(pp) activations regardless of M — the
+    reference 1F1B's whole point. Temp memory must be flat in M (scan
+    bookkeeping only; far below one activation per extra microbatch)."""
+    t8 = _temp_bytes(mesh, 8, remat=False, memory_efficient=True)
+    t32 = _temp_bytes(mesh, 32, remat=False, memory_efficient=True)
+    act_bytes = MB * D * 4
+    slope = (t32 - t8) / 24
+    assert slope < act_bytes / 4, (t8, t32)
+
+
+def test_memory_efficient_matches_ad_schedule_outputs(mesh):
+    """Same loss and grads as the AD-through-the-scan driver (which is
+    itself pinned against no-pipelining elsewhere)."""
+    rng = np.random.RandomState(1)
+    ws = jnp.asarray(rng.randn(PP, D, D) * 0.1, jnp.float32)
+    micro = jnp.asarray(rng.randn(8, MB, D), jnp.float32)
+
+    def run(memory_efficient):
+        def inner(ws):
+            return forward_backward_pipelining_without_interleaving(
+                _stage_fn, micro, {"w": ws[0]},
+                loss_fn=lambda y, m: jnp.mean(y ** 2),
+                memory_efficient=memory_efficient)
+        return shard_map(inner, mesh=mesh, in_specs=(P("pipe"),),
+                         out_specs=(P(), {"w": P("pipe")}))(ws)
+
+    loss_a, grads_a = run(True)
+    loss_b, grads_b = run(False)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(grads_a["w"]),
+                               np.asarray(grads_b["w"]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ad_schedule_backward_memory_is_linear_in_microbatches(mesh):
+    """Honest bound for the AD driver: residual memory grows ~linearly with
+    M (ticks), unlike the default's O(pp)."""
+    t8 = _temp_bytes(mesh, 8, remat=False, memory_efficient=False)
+    t32 = _temp_bytes(mesh, 32, remat=False, memory_efficient=False)
     slope = (t32 - t8) / 24
     assert slope > 0
     # per-tick residual must be at least the carry (one activation/chunk)
@@ -74,13 +111,13 @@ def test_backward_memory_is_linear_in_microbatches(mesh):
     assert slope >= carry_bytes
 
 
-def test_remat_bounds_residuals_to_the_carry(mesh):
+def test_ad_schedule_remat_bounds_residuals_to_the_carry(mesh):
     """With remat=True each tick's residual is the carry (plus bounded
-    bookkeeping), not the per-layer intermediates: the per-microbatch slope
-    must drop well below the no-remat slope and stay within a small
-    multiple of the carry size."""
-    slope_plain = (_temp_bytes(mesh, 32, False) - _temp_bytes(mesh, 8, False)) / 24
-    slope_remat = (_temp_bytes(mesh, 32, True) - _temp_bytes(mesh, 8, True)) / 24
+    bookkeeping), not the per-layer intermediates."""
+    slope_plain = (_temp_bytes(mesh, 32, False, False)
+                   - _temp_bytes(mesh, 8, False, False)) / 24
+    slope_remat = (_temp_bytes(mesh, 32, True, False)
+                   - _temp_bytes(mesh, 8, True, False)) / 24
     carry_bytes = MB * D * 4
     # intra-stage residuals (3 tanh layers) are recomputed, not stored
     assert slope_remat <= slope_plain / 2
