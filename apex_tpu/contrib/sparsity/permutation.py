@@ -113,13 +113,18 @@ def greedy_swap_search(w2d: np.ndarray, m: int, n: int,
         part = np.partition(cols, m - n, axis=-1)[..., m - n:]
         return float(part.sum())
 
+    all_pairs = n_groups * (n_groups - 1) // 2
     for _ in range(max_passes):
-        pairs = [(a, b) for a in range(n_groups) for b in range(a + 1,
-                                                                n_groups)]
-        if pairs_per_pass is not None and len(pairs) > pairs_per_pass:
-            idx = rng.choice(len(pairs), pairs_per_pass, replace=False)
-            pairs = [pairs[i] for i in idx]
-        rng.shuffle(pairs)
+        # sample group pairs directly — materializing the O(n_groups^2)
+        # pair list would cost the quadratic work the sampling avoids
+        if all_pairs <= pairs_per_pass:
+            pairs = [(a, b) for a in range(n_groups)
+                     for b in range(a + 1, n_groups)]
+            rng.shuffle(pairs)
+        else:
+            ab = rng.randint(0, n_groups, (2 * pairs_per_pass + 16, 2))
+            pairs = [(int(a), int(b)) for a, b in ab
+                     if a != b][:pairs_per_pass]
         improved = False
         for a, b in pairs:
             ia = perm[a * m:(a + 1) * m].copy()

@@ -40,6 +40,16 @@ class ResNetConfig:
     # chip removes the fp32 elementwise traffic of the fwd+bwd normalize —
     # measured 6% off the headline step, 86.7->79.8 GB/step (docs/PERF.md)
     bn_apply_compute_dtype: bool = True
+    # MLPerf-style conv0 reformulation: fold 2x2 spatial blocks of the
+    # input into channels (224x224x3 -> 112x112x12) and run the stem as a
+    # 4x4 stride-1 conv with correspondingly rearranged (zero-padded 8x8)
+    # weights — bit-identical math (parity-tested), 12 input channels
+    # instead of 3 on the MXU contraction dim. Default OFF by measurement:
+    # on v5e the headline step got SLOWER (94.1 -> 101.3 ms same-session
+    # A/B) — this-generation XLA already handles the small-C stem well and
+    # the asymmetric-padding form costs more than it saves. Kept as an
+    # option for other chip generations.
+    stem_space_to_depth: bool = False
 
 
 def _conv_init(key, shape, dtype):
@@ -127,7 +137,7 @@ def _bn_apply(cfg, p, s, x, training, z=None, fuse_relu=True):
     # reference's keep_batchnorm_fp32 guards against, so an fp16
     # compute_dtype keeps the fp32 apply
     apply_dtype = (cfg.compute_dtype
-                   if (getattr(cfg, "bn_apply_compute_dtype", False)
+                   if (cfg.bn_apply_compute_dtype
                        and jnp.dtype(cfg.compute_dtype) == jnp.bfloat16)
                    else None)
     return sync_batch_norm(
@@ -178,14 +188,38 @@ class ResNet50:
             "bias": jnp.zeros(cfg.num_classes, cfg.params_dtype)}
         return params, state
 
+    def _stem_conv(self, w, x):
+        """The 7x7/stride-2 stem conv, optionally in space-to-depth form
+        (``stem_space_to_depth``): u = 2a + da - ... each original tap
+        index u in [0,7) decomposes as u = 2*ka + da - 1 with ka in [0,4),
+        da in {0,1}, so padding the kernel to 8x8 on the low side and
+        folding (da, db) into channels gives an exactly-equivalent 4x4
+        stride-1 conv over the 2x2-block-folded input, with asymmetric
+        spatial padding (2,1)."""
+        if not self.cfg.stem_space_to_depth:
+            return jax.lax.conv_general_dilated(
+                x, w.astype(x.dtype), (2, 2), [(3, 3), (3, 3)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        n, hh, ww, c = x.shape
+        if hh % 2 or ww % 2:
+            raise ValueError("space-to-depth stem needs even input dims")
+        xs = x.reshape(n, hh // 2, 2, ww // 2, 2, c)
+        xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(n, hh // 2, ww // 2,
+                                                    4 * c)
+        w8 = jnp.pad(w.astype(x.dtype), ((1, 0), (1, 0), (0, 0), (0, 0)))
+        w4 = w8.reshape(4, 2, 4, 2, c, w.shape[-1])
+        w4 = w4.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * c,
+                                                    w.shape[-1])
+        return jax.lax.conv_general_dilated(
+            xs, w4, (1, 1), [(2, 1), (2, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
     def __call__(self, params, state, x, training=True):
         """x: (n, h, w, 3) NHWC; returns (logits fp32, new_state)."""
         cfg = self.cfg
         x = x.astype(cfg.compute_dtype)
         new_state = {"stem": {}}
-        h = jax.lax.conv_general_dilated(
-            x, params["stem"]["conv"].astype(x.dtype), (2, 2),
-            [(3, 3), (3, 3)], dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        h = self._stem_conv(params["stem"]["conv"], x)
         h, new_state["stem"]["bn"] = _bn_apply(
             cfg, params["stem"]["bn"], state["stem"]["bn"], h, training)
         h = jax.lax.reduce_window(

@@ -274,25 +274,8 @@ def pipelined_apply(
 # memory-efficient 1F1B: hand-driven vjp inside the tick scan
 # ---------------------------------------------------------------------------
 
+from apex_tpu.utils.vma import fixed_point_vma as _fixed_point_vma
 from apex_tpu.utils.vma import leaf_vma as _leaf_vma
-
-
-def _fixed_point_vma(tick, init, max_iters: int = 8):
-    """Per-LEAF varying-axes fixed point for a scan carry: each leaf keeps
-    the minimal axes the body actually varies it over (a global union would
-    over-vary e.g. tensor-replicated LN grad accumulators, breaking the
-    caller's out_specs)."""
-    vma_tree = jax.tree_util.tree_map(_leaf_vma, init)
-    for _ in range(max_iters):
-        init_c = jax.tree_util.tree_map(cast_to_vma, init, vma_tree)
-        out = jax.eval_shape(lambda c: tick(c, jnp.asarray(0))[0], init_c)
-        new_tree = jax.tree_util.tree_map(
-            lambda v, o: v | _leaf_vma(o), vma_tree, out)
-        if jax.tree_util.tree_all(jax.tree_util.tree_map(
-                lambda a, b: a == b, vma_tree, new_tree)):
-            break
-        vma_tree = new_tree
-    return vma_tree
 
 
 def _onef1b_fwd_bwd(stage_fn, loss_fn, params, microbatches, remat,
@@ -453,7 +436,7 @@ def _onef1b_fwd_bwd(stage_fn, loss_fn, params, microbatches, remat,
     # fixed-point each carry leaf's varying-axes set (the stage body may
     # add axes, e.g. TP makes activations tensor-varying, while LN grad
     # accumulators must stay tensor-replicated)
-    vma_tree = _fixed_point_vma(tick, init)
+    vma_tree = _fixed_point_vma(tick, init, jnp.asarray(0))
 
     def tick_stable(carry, t):
         new_carry, _ = tick(carry, t)

@@ -16,7 +16,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["cast_to_vma", "scan_stable_vma", "invariant_all_gather",
-           "reconcile_cotangent", "restore_invariant", "leaf_vma"]
+           "reconcile_cotangent", "restore_invariant", "leaf_vma",
+           "fixed_point_vma"]
 
 
 def leaf_vma(x) -> frozenset:
@@ -74,28 +75,45 @@ def cast_to_vma(x: jnp.ndarray, vma: frozenset) -> jnp.ndarray:
     return x
 
 
-def scan_stable_vma(body: Callable, init: Any, xs: Any, max_iters: int = 4):
-    """``lax.scan`` whose carry VMA is fixed-pointed against the body.
+def fixed_point_vma(body: Callable, init: Any, x0: Any = None,
+                    max_iters: int = 8) -> Any:
+    """Per-LEAF varying-axes fixed point for a scan carry.
 
-    ``body(carry, x) -> (carry, y)`` with a single-array carry.
+    ``body(carry, x) -> (carry, ...)``; ``x0`` is a representative first
+    scan element (None for a body that ignores ``x``). Returns a pytree of
+    frozensets, one per carry leaf — the minimal axes the body actually
+    varies each leaf over. Per-leaf minimality matters: a global union
+    would over-vary replicated leaves (e.g. tensor-replicated LN grad
+    accumulators), breaking replicated out_specs and making AD insert
+    spurious cross-replica psums.
     """
-    carry_vma = getattr(jax.typeof(init), "vma", None) or frozenset()
+    vma_tree = jax.tree_util.tree_map(leaf_vma, init)
     for _ in range(max_iters):
-        init_c = cast_to_vma(init, carry_vma)
-        first_x = jax.tree_util.tree_map(
-            lambda v: jax.lax.index_in_dim(v, 0, 0, keepdims=False), xs)
-        out_vma = getattr(jax.eval_shape(lambda c, x: body(c, x)[0],
-                                         init_c, first_x),
-                          "vma", None) or frozenset()
-        if out_vma <= carry_vma:
+        init_c = jax.tree_util.tree_map(cast_to_vma, init, vma_tree)
+        out = jax.eval_shape(lambda c: body(c, x0)[0], init_c)
+        new_tree = jax.tree_util.tree_map(
+            lambda v, o: v | leaf_vma(o), vma_tree, out)
+        if jax.tree_util.tree_all(jax.tree_util.tree_map(
+                lambda a, b: a == b, vma_tree, new_tree)):
             break
-        carry_vma = carry_vma | out_vma
+        vma_tree = new_tree
+    return vma_tree
+
+
+def scan_stable_vma(body: Callable, init: Any, xs: Any, max_iters: int = 4):
+    """``lax.scan`` whose carry VMA is fixed-pointed against the body
+    (per-leaf, via :func:`fixed_point_vma`)."""
+    first_x = jax.tree_util.tree_map(
+        lambda v: jax.lax.index_in_dim(v, 0, 0, keepdims=False), xs)
+    vma_tree = fixed_point_vma(body, init, first_x, max_iters=max_iters)
 
     def stable_body(carry, x):
         new_c, y = body(carry, x)
-        return cast_to_vma(new_c, carry_vma), y
+        return jax.tree_util.tree_map(cast_to_vma, new_c, vma_tree), y
 
-    return jax.lax.scan(stable_body, cast_to_vma(init, carry_vma), xs)
+    return jax.lax.scan(
+        stable_body, jax.tree_util.tree_map(cast_to_vma, init, vma_tree),
+        xs)
 
 
 def invariant_all_gather(x: jnp.ndarray, axis_name: str, axis: int = 0

@@ -345,22 +345,23 @@ def bench_gpt(iters=20, warmup=3):
     tok_per_sec = batch * seq / float(np.mean(times))
 
     # anchor: 40% MFU — the published llm.c/nanoGPT-class utilization for
-    # GPT-2-124M-scale A100 training — over THIS chip's peak, using the
-    # compiled step's exact FLOP count. vs_baseline > 1 means the step
-    # beats that standard; the reference publishes no GPT numbers
-    # (BASELINE.md) so a utilization anchor is the defensible comparison.
-    vs_anchor = None
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        flops_per_tok = float(cost["flops"]) / (batch * seq)
-        if flops_per_tok > 0 and np.isfinite(flops_per_tok):
-            vs_anchor = tok_per_sec / (0.40 * _peak_flops() / flops_per_tok)
-    except Exception:
-        pass
+    # GPT-2-124M-scale A100 training — over THIS chip's peak. Model flops
+    # use the standard analytic count (llm.c / PaLM-appendix convention:
+    # 6N per token for the parameter matmuls fwd+bwd, plus 12*L*d_model*seq
+    # for attention) — XLA's cost_analysis cannot be used here because the
+    # Mosaic flash-attention custom calls report zero flops, deflating MFU
+    # ~4x. vs_baseline > 1 means the step beats the 40%-MFU standard; the
+    # reference publishes no GPT numbers (BASELINE.md) so a utilization
+    # anchor is the defensible comparison.
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(params))
+    flops_per_tok = (6.0 * n_params
+                     + 12.0 * cfg.num_layers * cfg.hidden_size * seq)
+    vs_anchor = tok_per_sec / (0.40 * _peak_flops() / flops_per_tok)
+    mfu = tok_per_sec * flops_per_tok / _peak_flops()
     _emit("gpt_small_train_tokens_per_sec", tok_per_sec, "tokens/sec",
           vs_anchor, anchor="40pct_mfu_this_chip",
+          mfu=round(float(mfu), 4),
           step_ms=round(float(np.mean(times) * 1e3), 3),
           std_ms=round(float(np.std(times) * 1e3), 3),
           batch=batch, seq=seq)

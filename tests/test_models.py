@@ -361,3 +361,30 @@ def test_gpt_sequence_parallel_matches_tp():
                                        rtol=2e-5, atol=2e-6)
     finally:
         parallel_state.destroy_model_parallel()
+
+
+def test_stem_space_to_depth_parity():
+    """The conv0 space-to-depth reformulation is bit-equivalent math:
+    fwd values, dW, and dX all match the plain 7x7/2 stem (the option is
+    default-off by measurement — docs/PERF.md — but must stay correct)."""
+    from apex_tpu.models import ResNet50, ResNetConfig
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 64, 64, 3), jnp.float32)
+    w = jnp.asarray(rng.randn(7, 7, 3, 16) * 0.1, jnp.float32)
+    plain = ResNet50(ResNetConfig(compute_dtype=jnp.float32,
+                                  stem_space_to_depth=False))
+    s2d = ResNet50(ResNetConfig(compute_dtype=jnp.float32,
+                                stem_space_to_depth=True))
+    np.testing.assert_allclose(np.asarray(plain._stem_conv(w, x)),
+                               np.asarray(s2d._stem_conv(w, x)),
+                               rtol=1e-5, atol=1e-5)
+    for m, n in [(plain, s2d)]:
+        gw_a = jax.grad(lambda w: jnp.sum(m._stem_conv(w, x) ** 2))(w)
+        gw_b = jax.grad(lambda w: jnp.sum(n._stem_conv(w, x) ** 2))(w)
+        np.testing.assert_allclose(np.asarray(gw_a), np.asarray(gw_b),
+                                   rtol=1e-4, atol=1e-4)
+        gx_a = jax.grad(lambda x: jnp.sum(m._stem_conv(w, x) ** 2))(x)
+        gx_b = jax.grad(lambda x: jnp.sum(n._stem_conv(w, x) ** 2))(x)
+        np.testing.assert_allclose(np.asarray(gx_a), np.asarray(gx_b),
+                                   rtol=1e-4, atol=1e-4)
