@@ -123,8 +123,18 @@ def greedy_swap_search(w2d: np.ndarray, m: int, n: int,
             rng.shuffle(pairs)
         else:
             ab = rng.randint(0, n_groups, (2 * pairs_per_pass + 16, 2))
-            pairs = [(int(a), int(b)) for a, b in ab
-                     if a != b][:pairs_per_pass]
+            seen = set()
+            pairs = []
+            for a, b in ab:
+                if a == b:
+                    continue
+                key = (int(min(a, b)), int(max(a, b)))
+                if key in seen:
+                    continue
+                seen.add(key)
+                pairs.append(key)
+                if len(pairs) == pairs_per_pass:
+                    break
         improved = False
         for a, b in pairs:
             ia = perm[a * m:(a + 1) * m].copy()

@@ -34,6 +34,10 @@ def main(argv=None):
     ap.add_argument("--vocab", type=int, default=128)
     ap.add_argument("--micro-batch", type=int, default=2)
     ap.add_argument("--num-micro", type=int, default=4)
+    ap.add_argument("--zero", action="store_true",
+                    help="shard optimizer state 1/dp over the data axis "
+                         "(DistributedFusedAdam; reduce_scatter grads, "
+                         "all_gather params)")
     args = ap.parse_args(argv)
 
     tp, pp = args.tp, args.pp
@@ -49,7 +53,8 @@ def main(argv=None):
                                 pipeline_model_parallel_size=pp),
         batch=BatchConfig(global_batch_size=M * mb * dp,
                           micro_batch_size=mb),
-        optimizer=OptimizerConfig(name="adam", lr=1e-3, weight_decay=0.0),
+        optimizer=OptimizerConfig(name="adam", lr=1e-3, weight_decay=0.0,
+                                  zero=args.zero),
         opt_level="O0")
 
     mesh = cfg.initialize_mesh()

@@ -35,6 +35,16 @@ def test_gpt_pretrain_runs():
     assert loss > 0
 
 
+def test_gpt_pretrain_zero_runs():
+    """--zero swaps in the ZeRO sharded optimizer (DistributedFusedAdam)
+    inside the same hybrid trainer; the loss trajectory must stay finite
+    and positive."""
+    import gpt_pretrain
+    loss = gpt_pretrain.main(["--tp", "2", "--pp", "2", "--steps", "2",
+                              "--zero"])
+    assert loss > 0
+
+
 def test_dcgan_amp_runs():
     import dcgan_amp
     errD, errG = dcgan_amp.main(["--steps", "3", "--batch", "8"])

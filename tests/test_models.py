@@ -379,12 +379,11 @@ def test_stem_space_to_depth_parity():
     np.testing.assert_allclose(np.asarray(plain._stem_conv(w, x)),
                                np.asarray(s2d._stem_conv(w, x)),
                                rtol=1e-5, atol=1e-5)
-    for m, n in [(plain, s2d)]:
-        gw_a = jax.grad(lambda w: jnp.sum(m._stem_conv(w, x) ** 2))(w)
-        gw_b = jax.grad(lambda w: jnp.sum(n._stem_conv(w, x) ** 2))(w)
-        np.testing.assert_allclose(np.asarray(gw_a), np.asarray(gw_b),
-                                   rtol=1e-4, atol=1e-4)
-        gx_a = jax.grad(lambda x: jnp.sum(m._stem_conv(w, x) ** 2))(x)
-        gx_b = jax.grad(lambda x: jnp.sum(n._stem_conv(w, x) ** 2))(x)
-        np.testing.assert_allclose(np.asarray(gx_a), np.asarray(gx_b),
-                                   rtol=1e-4, atol=1e-4)
+    gw_a = jax.grad(lambda w: jnp.sum(plain._stem_conv(w, x) ** 2))(w)
+    gw_b = jax.grad(lambda w: jnp.sum(s2d._stem_conv(w, x) ** 2))(w)
+    np.testing.assert_allclose(np.asarray(gw_a), np.asarray(gw_b),
+                               rtol=1e-4, atol=1e-4)
+    gx_a = jax.grad(lambda x: jnp.sum(plain._stem_conv(w, x) ** 2))(x)
+    gx_b = jax.grad(lambda x: jnp.sum(s2d._stem_conv(w, x) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(gx_a), np.asarray(gx_b),
+                               rtol=1e-4, atol=1e-4)
