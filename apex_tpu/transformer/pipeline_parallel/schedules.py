@@ -10,15 +10,21 @@ TPU redesign. The reference drives each microbatch's fwd/bwd from Python
 with explicit NCCL p2p — impossible and unnecessary under jit. Here a
 schedule is a *traced program*: a ``lax.scan`` over pipeline ticks inside
 ``shard_map`` over the ``pipe`` axis, with one ``ppermute`` rotation per
-tick. Differentiating the scan yields the backward pipeline automatically
-(the transpose of ``ppermute`` is the reverse rotation; the reversed scan
-replays the cooldown/steady/warmup structure), so ONE code path serves
-forward-only and forward+backward — the reference's 340-line warmup/steady/
-cooldown bookkeeping is the autodiff of this scan. Activation memory is
-O(ticks) per stage by default; pass ``remat=True`` to rematerialize each
-tick in backward (``jax.checkpoint``), the analog of the reference's
-activation-checkpoint + ``free_output_tensor`` tricks
-(:schedules/common.py:198-249).
+tick. Two backward drivers exist:
+
+* the DEFAULT (``memory_efficient=True``, :func:`_onef1b_fwd_bwd`): one
+  scan whose tick runs one forward AND one backward microbatch per global
+  stage via explicit ``jax.vjp`` with recompute — the true 1F1B memory
+  bound, O(pp·vpp) in-flight activations regardless of microbatch count
+  (the role of the reference's interleaved fwd/bwd +
+  ``free_output_tensor``, :schedules/common.py:198-249);
+* the AD driver (``memory_efficient=False``): differentiating the
+  forward tick scan yields the backward pipeline automatically (the
+  transpose of ``ppermute`` is the reverse rotation; the reversed scan
+  replays the cooldown/steady/warmup structure) — the reference's
+  340-line warmup/steady/cooldown bookkeeping as autodiff. Residuals are
+  O(ticks) per stage; ``remat=True`` shrinks each tick's residual to the
+  carry.
 
 The stage function must be *stage-uniform* (same jaxpr on every device) and
 branch on the traced stage index for first/last specifics — the SPMD analog
@@ -279,11 +285,12 @@ from apex_tpu.utils.vma import leaf_vma as _leaf_vma
 
 
 def _onef1b_fwd_bwd(stage_fn, loss_fn, params, microbatches, remat,
-                    grad_scale, shared_params=None, embed_fn=None):
-    """True-1F1B-memory pipelined forward+backward (single chunk per stage).
+                    grad_scale, shared_params=None, embed_fn=None,
+                    num_chunks=1, chunked_params=False):
+    """True-1F1B-memory pipelined forward+backward.
 
     The AD-through-the-tick-scan path (:func:`pipelined_apply`) stores one
-    residual per tick — O(M + S) activations per device. The reference's
+    residual per tick — O(M + L) activations per device. The reference's
     1F1B exists precisely to avoid that
     (``reference:apex/transformer/pipeline_parallel/schedules/
     fwd_bwd_pipelining_without_interleaving.py:155-345`` holds at most
@@ -291,26 +298,39 @@ def _onef1b_fwd_bwd(stage_fn, loss_fn, params, microbatches, remat,
     ``common.py:198-249``, frees each output the moment its consumer is
     done). This driver reproduces that bound the SPMD way: ONE scan whose
     tick does one forward microbatch AND one backward microbatch per
-    device, with the backward built from an explicit ``jax.vjp`` that
-    *recomputes* the stage forward (the reference's
+    global stage, with the backward built from an explicit ``jax.vjp``
+    that *recomputes* the stage forward (the reference's
     activation-checkpoint + free trade). The scan itself is never
     differentiated, so its carry — not AD residuals — is the whole
     activation memory:
 
-    - ``saved``: 2S input-activation slots (the in-flight window; at stage
-      d only ``2(S-d)-1`` are live, slot reuse is mod-2S),
-    - one in-transit activation + one in-transit cotangent,
+    - ``saved``: per-chunk input-activation rings of ``2(L - c*S)`` slots
+      (chunk c's in-flight window; at global stage g only ``2(L-g)-1``
+      are live),
+    - one in-transit activation + one in-transit cotangent per chunk,
     - the fp32 grad accumulators.
 
-    Backward of microbatch m at stage d runs at tick ``m + 2S - 1 - d``;
-    total ticks ``M + 2S - 2 + 1``. The cotangent for (m, d) arrives from
-    stage d+1's ``dx`` of the previous tick via the reverse rotation; the
-    last stage seeds from the loss vjp. Bubble ticks carry exactly-zero
-    cotangents (vjp is linear in the seed), so no masking of the grad
-    accumulation is needed beyond the loss/seed masks.
+    With ``num_chunks`` = V > 1 this is the interleaved virtual pipeline
+    (Megatron layout: chunk c on device d is global stage ``g = c*S + d``,
+    L = S*V global stages,
+    ``reference:.../fwd_bwd_pipelining_with_interleaving.py:25-375``);
+    V = 1 reduces to plain 1F1B. Microbatch m runs forward at global
+    stage g at tick ``m + g`` and backward at tick ``m + 2L - 1 - g``;
+    total ticks ``M + 2L - 1``. The cotangent for (m, g) arrives from
+    stage g+1's ``dx`` of the previous tick via the reverse rotation
+    (wrapping from device 0 chunk c+1 back to device S-1 chunk c — the
+    mirror of the forward wrap); the last global stage seeds from the
+    loss vjp. Bubble ticks carry exactly-zero cotangents (vjp is linear
+    in the seed), so no masking of the grad accumulation is needed beyond
+    the loss/seed masks.
+
+    Slot-reuse safety: a forward write at m_f can only collide with a
+    pending backward read at m_b if the (even) chunk ring size divides
+    m_f - m_b = 2L - 1 - 2g, which is odd — impossible; and the ring
+    covers the window since 2(L - c*S) >= 2L - 2g for every device.
 
     Compiled temp memory is O(1) in M — asserted by
-    ``tests/test_pipeline_memory.py::test_memory_efficient_1f1b_is_O1_in_microbatches``.
+    ``tests/test_pipeline_memory.py``.
     """
     if embed_fn is not None and shared_params is None:
         raise ValueError(
@@ -319,8 +339,23 @@ def _onef1b_fwd_bwd(stage_fn, loss_fn, params, microbatches, remat,
     S = jax.lax.axis_size(PIPE_AXIS)
     rank = jax.lax.axis_index(PIPE_AXIS)
     M = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
-    B = 2 * S
-    T = M + 2 * S - 1
+    V = num_chunks
+    L = S * V
+    T = M + 2 * L - 1
+    # per-chunk saved-activation window: chunk c's global stages start at
+    # c*S, so at most 2(L - c*S) - 1 microbatches are in flight there; an
+    # EVEN buffer size keeps the odd-difference collision-safety argument
+    # (below) while not over-allocating the uniform 2L for every chunk
+    B = [2 * (L - c * S) for c in range(V)]
+    # chunked_params: caller passes leaves with a leading (num_chunks, ...)
+    # axis (the interleaved API, valid even at num_chunks=1); otherwise raw
+    stacked = chunked_params
+    p_stack = params if stacked else jax.tree_util.tree_map(
+        lambda p: p[None], params)
+
+    def chunk_params(c):
+        return jax.tree_util.tree_map(
+            lambda p: jax.lax.index_in_dim(p, c, 0, keepdims=False), p_stack)
 
     f = jax.checkpoint(stage_fn) if remat else stage_fn
 
@@ -344,93 +379,129 @@ def _onef1b_fwd_bwd(stage_fn, loss_fn, params, microbatches, remat,
             return embed_fn(shared, mb).astype(act_dtype)
         return mb.astype(act_dtype)
 
-    def stage_and_loss(p, shared, xb, mb, m):
-        """Uniform composite: stage 0 re-derives its input from the
-        microbatch (so embed params are differentiated), other stages use
-        the saved input; the loss head is evaluated everywhere but seeded
-        only on the last stage. ``mb`` must already be chained into the
-        tick's collective order (see the barriers in ``tick``)."""
-        x_in = jnp.where(rank == 0, first_stage_input(shared, mb), xb)
-        y = f(p, x_in, rank)
-        if shared_params is None:
-            l = loss_fn(y, m)
+    def stage_and_loss(p, shared, xb, mb, m, c):
+        """Uniform composite for chunk ``c``: global stage 0 re-derives its
+        input from the microbatch (so embed params are differentiated),
+        other stages use the saved input; the loss head runs only on the
+        last local chunk (static) and is seeded only on the last device.
+        ``mb``/``xb`` must already be chained into the tick's collective
+        order (see the barriers in ``tick``)."""
+        if c == 0:
+            x_in = jnp.where(rank == 0, first_stage_input(shared, mb), xb)
         else:
-            l = loss_fn(shared, y, m)
+            x_in = xb
+        y = f(p, x_in, c * S + rank)
+        if c == V - 1:
+            l = loss_fn(y, m) if shared_params is None \
+                else loss_fn(shared, y, m)
+        else:
+            l = jnp.zeros((), jnp.float32)
         return y.astype(act_dtype), l
 
-    zero_act = jnp.zeros(act_shape, act_dtype)
     f32 = jnp.float32
 
     def tick(carry, t):
-        act_in, cot_in, saved, acc_g, acc_sg, loss_sum = carry
+        act_bufs, cot_bufs, saved, acc_g, acc_sg, loss_sum = carry
+        # collective-ordering note: the forward rotation, each chunk's
+        # stage apply / vjp psums, and the backward rotation are mutually
+        # data-independent, and XLA's CPU thunk runtime may run
+        # independent collectives concurrently per device — with devices
+        # arriving in different orders the rendezvous can cross-match and
+        # hit the 40s abort. optimization_barriers thread every chunk's
+        # work into one global order. (On TPU the static schedule makes
+        # them no-ops.)
+        chain = None
 
-        # ---- forward sub-tick: microbatch m_f enters this stage ----
-        m_f = t - rank
-        # the embed's collectives depend only on loop-invariants, so they
-        # would float free of the tick's collective order — chain the
-        # microbatch slice behind the carried activation first (see the
-        # ordering note below)
-        mb_f, act_in = jax.lax.optimization_barrier((mb_at(m_f), act_in))
-        x_in = jnp.where(rank == 0,
-                         first_stage_input(shared_params, mb_f), act_in)
-        y = f(params, x_in, rank)
-        # slot reuse is safe even for bubble writes: a write at m_f can
-        # only collide with a pending read at m_b if 2S | (m_f - m_b) =
-        # 2S - 1 - 2*rank, which is odd — impossible
-        saved = jax.lax.dynamic_update_index_in_dim(
-            saved, x_in, jnp.mod(m_f, B), 0)
-        act_next = rotate_forward(y.astype(act_dtype))
+        # ---- forward sub-tick: one microbatch enters each global stage
+        outs = []
+        for c in range(V):
+            m_f = t - (c * S + rank)
+            x = jax.lax.index_in_dim(act_bufs, c, 0, keepdims=False)
+            if chain is not None:
+                x, _ = jax.lax.optimization_barrier((x, chain))
+            if c == 0:
+                # the embed's collectives depend only on loop-invariants;
+                # chain the microbatch slice behind the carried activation
+                mb_f, x = jax.lax.optimization_barrier((mb_at(m_f), x))
+                x = jnp.where(rank == 0,
+                              first_stage_input(shared_params, mb_f), x)
+            y = f(chunk_params(c), x, c * S + rank)
+            saved = (saved[:c]
+                     + (saved[c].at[jnp.mod(m_f, B[c])].set(x),)
+                     + saved[c + 1:])
+            outs.append(y.astype(act_dtype))
+            chain = outs[-1]
+        received = rotate_forward(jnp.stack(outs))
+        new_act = [received[0]]
+        for c in range(1, V):
+            # wrap: device 0's chunk c consumes last device's chunk c-1
+            new_act.append(jnp.where(rank == 0, received[c - 1],
+                                     received[c]))
+        act_next = jnp.stack(new_act)
+        chain, saved = jax.lax.optimization_barrier((act_next, saved))
 
-        # ---- backward sub-tick: microbatch m_b leaves this stage ----
-        m_b = t - 2 * S + 1 + rank
-        valid_b = jnp.logical_and(m_b >= 0, m_b < M)
-        # sequence the tick's collectives: the forward rotation, the vjp's
-        # internal psums, and the backward rotation are data-independent,
-        # and XLA's CPU thunk runtime may run independent collectives
-        # concurrently per device — with devices arriving in different
-        # orders the rendezvous can cross-match and hit the 40s abort. The
-        # barrier threads act_next into the backward half so every device
-        # issues the collectives in one global order. (On TPU the static
-        # schedule makes this a no-op.)
-        act_next, saved = jax.lax.optimization_barrier((act_next, saved))
-        xb = jax.lax.dynamic_index_in_dim(saved, jnp.mod(m_b, B), 0,
-                                          keepdims=False)
-        xb, mb_b = jax.lax.optimization_barrier((xb, mb_at(m_b)))
-        (y_b, l_b), vjp_fn = jax.vjp(
-            lambda p, sh, x: stage_and_loss(p, sh, x, mb_b, m_b),
-            params, shared_params, xb)
-        is_last = rank == S - 1
-        dy = jnp.where(is_last, jnp.zeros_like(cot_in), cot_in)
-        dl = jnp.where(jnp.logical_and(is_last, valid_b),
-                       jnp.asarray(grad_scale, f32) / M,
-                       jnp.asarray(0.0, f32))
-        # seed types must match the primal outputs' varying axes exactly
-        # (e.g. data-varying under the DDP pattern)
-        dy = cast_to_vma(dy.astype(y_b.dtype),
-                         getattr(jax.typeof(y_b), "vma", frozenset()))
-        dl = cast_to_vma(dl.astype(l_b.dtype),
-                         getattr(jax.typeof(l_b), "vma", frozenset()))
-        dparams, dshared, dxb = vjp_fn((dy, dl))
-        acc_g = jax.tree_util.tree_map(
-            lambda a, g: a + g.astype(f32), acc_g, dparams)
-        if shared_params is not None:
-            acc_sg = jax.tree_util.tree_map(
-                lambda a, g: a + g.astype(f32), acc_sg, dshared)
-        loss_sum = loss_sum + jnp.where(
-            jnp.logical_and(is_last, valid_b), l_b.astype(f32), 0.0)
-        cot_next = rotate_backward(dxb.astype(act_dtype))
+        # ---- backward sub-tick: one microbatch leaves each global stage
+        dxs = []
+        for c in range(V):
+            g = c * S + rank
+            m_b = t - 2 * L + 1 + g
+            valid_b = jnp.logical_and(m_b >= 0, m_b < M)
+            xb = saved[c][jnp.mod(m_b, B[c])]
+            xb, _ = jax.lax.optimization_barrier((xb, chain))
+            xb, mb_b = jax.lax.optimization_barrier((xb, mb_at(m_b)))
+            (y_b, l_b), vjp_fn = jax.vjp(
+                lambda p, sh, x: stage_and_loss(p, sh, x, mb_b, m_b, c),
+                chunk_params(c), shared_params, xb)
+            dy = jax.lax.index_in_dim(cot_bufs, c, 0, keepdims=False)
+            if c == V - 1:
+                # global stage L-1 seeds from the loss, not the rotation
+                dy = jnp.where(rank == S - 1, jnp.zeros_like(dy), dy)
+                dl = jnp.where(
+                    jnp.logical_and(rank == S - 1, valid_b),
+                    jnp.asarray(grad_scale, f32) / M, jnp.asarray(0.0, f32))
+                loss_sum = loss_sum + jnp.where(
+                    jnp.logical_and(rank == S - 1, valid_b),
+                    l_b.astype(f32), 0.0)
+            else:
+                dl = jnp.asarray(0.0, f32)
+            # seed types must match the primal outputs' varying axes
+            # exactly (e.g. data-varying under the DDP pattern)
+            dy = cast_to_vma(dy.astype(y_b.dtype), _leaf_vma(y_b))
+            dl = cast_to_vma(dl.astype(l_b.dtype), _leaf_vma(l_b))
+            dparams, dshared, dxb = vjp_fn((dy, dl))
+            acc_g = jax.tree_util.tree_map(
+                lambda a, dg: a.at[c].add(dg.astype(f32)), acc_g, dparams)
+            if shared_params is not None:
+                acc_sg = jax.tree_util.tree_map(
+                    lambda a, dg: a + dg.astype(f32), acc_sg, dshared)
+            dxs.append(dxb.astype(act_dtype))
+            chain = dxs[-1]
+        recv_d = rotate_backward(jnp.stack(dxs))
+        new_cot = []
+        for c in range(V):
+            if c < V - 1:
+                # wrap mirror: device S-1's chunk c consumes device 0's
+                # chunk c+1 (global stage g+1 = (c+1)*S)
+                new_cot.append(jnp.where(rank == S - 1, recv_d[c + 1],
+                                         recv_d[c]))
+            else:
+                new_cot.append(recv_d[c])  # rank S-1 re-seeded above
+        cot_next = jnp.stack(new_cot)
         # close the chain: the next tick's forward rotation must not start
-        # until this tick's backward rotation is issued (see barrier above)
+        # until this tick's backward rotation is issued
         act_next, cot_next = jax.lax.optimization_barrier(
             (act_next, cot_next))
 
         return (act_next, cot_next, saved, acc_g, acc_sg, loss_sum), None
 
     zeros_g = jax.tree_util.tree_map(
-        lambda p: jnp.zeros(jnp.shape(p), f32), params)
+        lambda p: jnp.zeros(jnp.shape(p), f32), p_stack)
     zeros_sg = (None if shared_params is None else jax.tree_util.tree_map(
         lambda p: jnp.zeros(jnp.shape(p), f32), shared_params))
-    init = (zero_act, zero_act, jnp.zeros((B,) + act_shape, act_dtype),
+    init = (jnp.zeros((V,) + act_shape, act_dtype),
+            jnp.zeros((V,) + act_shape, act_dtype),
+            tuple(jnp.zeros((B[c],) + act_shape, act_dtype)
+                  for c in range(V)),
             zeros_g, zeros_sg, jnp.asarray(0.0, f32))
 
     # fixed-point each carry leaf's varying-axes set (the stage body may
@@ -452,6 +523,8 @@ def _onef1b_fwd_bwd(stage_fn, loss_fn, params, microbatches, remat,
         jnp.where(rank == S - 1, loss_sum / M, 0.0), PIPE_AXIS)
     inv_scale = 1.0 / jnp.asarray(grad_scale, f32)
     stage_grads = jax.tree_util.tree_map(lambda g: g * inv_scale, acc_g)
+    if not stacked:
+        stage_grads = jax.tree_util.tree_map(lambda g: g[0], stage_grads)
     if shared_params is None:
         return mean_loss, stage_grads
 
@@ -599,12 +672,22 @@ def forward_backward_pipelining_with_interleaving(
     grad_scale: Any = 1.0,
     shared_params: Any = None,
     embed_fn: Optional[Callable] = None,
+    memory_efficient: bool = True,
 ):
     """Interleaved virtual-pipeline schedule
     (``fwd_bwd_pipelining_with_interleaving.py:25-375``): each device holds
     ``num_model_chunks`` stage chunks, Megatron layout (chunk c on device d =
     global stage ``c*S+d``). ``params`` leaves carry a leading
-    ``(num_model_chunks, ...)`` axis."""
+    ``(num_model_chunks, ...)`` axis.
+
+    ``memory_efficient=True`` (default) runs the vjp-driven 1F1B driver
+    with O(L)-in-flight activation memory (see :func:`_onef1b_fwd_bwd`);
+    ``False`` selects the AD-through-the-tick-scan driver."""
+    if memory_efficient and not forward_only:
+        return _onef1b_fwd_bwd(
+            forward_step_func, loss_fn, params, batch, remat, grad_scale,
+            shared_params=shared_params, embed_fn=embed_fn,
+            num_chunks=num_model_chunks, chunked_params=True)
     return _pipelined_fwd_bwd(
         forward_step_func, loss_fn, params, batch, num_model_chunks,
         forward_only, remat, grad_scale, shared_params=shared_params,
