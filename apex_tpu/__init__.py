@@ -12,6 +12,8 @@ Ground-up JAX/XLA/Pallas re-design with the capabilities of NVIDIA Apex
   - :mod:`apex_tpu.transformer`    — Megatron-style TP/PP toolkit on a Mesh
   - :mod:`apex_tpu.contrib`        — sparsity (ASP), transducer, groupbn, …
   - :mod:`apex_tpu.utils`          — rank-aware logging, timers, checkpointing
+  - :mod:`apex_tpu.observability`  — metrics registry, in-graph accumulators,
+    step reporter + sinks (structured telemetry; see docs/OBSERVABILITY.md)
 
 Unlike the reference there are no compiled extensions to feature-detect
 (``reference:apex/__init__.py:13-19``): every op has an XLA path, and Pallas
@@ -31,6 +33,7 @@ _LAZY_SUBMODULES = (
     "optimizers", "normalization", "ops", "parallel", "transformer",
     "contrib", "utils", "fp16_utils", "models", "multi_tensor_apply",
     "RNN", "reparameterization", "checkpoint", "config", "pyprof",
+    "observability",
 )
 
 

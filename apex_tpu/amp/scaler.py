@@ -21,6 +21,8 @@ from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.observability import ingraph as _metrics
+
 __all__ = [
     "LossScaleState",
     "DynamicLossScale",
@@ -79,6 +81,21 @@ def select_tree(pred: jnp.ndarray, on_true: Any, on_false: Any) -> Any:
     return jax.tree_util.tree_map(
         lambda t, f: jax.lax.select(pred, jnp.asarray(t), jnp.asarray(f)),
         on_true, on_false)
+
+
+def _record_scale_metrics(scale: jnp.ndarray, grads_finite: jnp.ndarray) -> None:
+    """Telemetry for every scale update — the structured replacement for
+    the reference's ``maybe_print`` on overflow
+    (``reference:apex/amp/scaler.py:204-217``). Thunked values: with no
+    collector active this adds nothing to the traced program."""
+    _metrics.record("amp/loss_scale",
+                    lambda: scale.astype(jnp.float32), reduce="mean")
+    overflowed = lambda: 1.0 - grads_finite.astype(jnp.float32)
+    _metrics.record("amp/overflow_count", overflowed, reduce="sum")
+    # the on-device select skips the whole optimizer step on overflow, so
+    # per step these coincide; kept as separate series because static
+    # scaling (no backoff) still skips, and sinks sum them independently
+    _metrics.record("amp/skipped_steps", overflowed, reduce="max")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -145,6 +162,7 @@ class DynamicLossScale:
             grads_finite, scale_if_finite,
             jnp.maximum(state.loss_scale * self.backoff_factor, self.min_scale))
         new_unskipped = jnp.where(grads_finite, unskipped_if_finite, 0)
+        _record_scale_metrics(new_scale, grads_finite)
         return LossScaleState(loss_scale=new_scale,
                               unskipped=new_unskipped.astype(jnp.int32))
 
@@ -181,6 +199,7 @@ class StaticLossScale:
         return DynamicLossScale.unscale(self, state, grads, cast_to)  # type: ignore[arg-type]
 
     def update(self, state: LossScaleState, grads_finite: jnp.ndarray) -> LossScaleState:
+        _record_scale_metrics(state.loss_scale, grads_finite)
         return state
 
 

@@ -94,7 +94,8 @@ def supports_pallas(n_rows: int, hidden: int) -> bool:
 def _sds(shape, dtype, like):
     """ShapeDtypeStruct carrying the varying-manual-axes of ``like`` (see
     the flash-attention twin: pallas_call under shard_map needs it)."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    from apex_tpu.utils.vma import leaf_vma
+    vma = leaf_vma(like)
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

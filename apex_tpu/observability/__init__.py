@@ -1,0 +1,44 @@
+"""apex_tpu.observability — structured training telemetry.
+
+The reference exposes runtime behavior only through ad-hoc prints (amp's
+``maybe_print``, ``reference:apex/amp/_amp_state.py:39-51``; Megatron
+``_Timers.log``) and the deprecated pyprof pipeline. This package is the
+structured replacement: one stream that answers "what did this step spend,
+where, on which rank" without a trace capture.
+
+Four layers, composable and each zero-cost when unused:
+
+- :mod:`~apex_tpu.observability.registry` — host-side counters, gauges and
+  fixed-bucket histograms (``Metric.observe()``), grouped in a
+  :class:`MetricsRegistry`;
+- :mod:`~apex_tpu.observability.ingraph` — the in-graph accumulator: traced
+  code calls :func:`record`, a reaping wrapper returns the recorded scalars
+  as a pytree of device values, and :func:`aggregate` psums them across the
+  mesh at report time (no host round-trips inside the step);
+- :mod:`~apex_tpu.observability.report` / ``sinks`` — a
+  :class:`StepReporter` snapshotting registry + ``Timers`` + in-graph
+  metrics each step into pluggable sinks (JSONL event log, TensorBoard
+  ``add_scalar`` writers, Chrome-trace span export);
+- :mod:`~apex_tpu.observability.runtime` — compile/recompile counters via
+  ``jax.monitoring`` listeners and a ``memory_stats()`` gauge sampler, so
+  recompilation storms and HBM growth land in the same stream.
+
+Hot paths in the library are pre-instrumented (``amp/*``, ``ddp/*``,
+``pipeline/*``, ``optim/*`` — see ``docs/OBSERVABILITY.md``); with no
+collector active every instrumentation point is a module-level no-op that
+adds nothing to the traced program.
+"""
+
+from apex_tpu.observability.registry import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, get_registry)
+from apex_tpu.observability.ingraph import (  # noqa: F401
+    Metrics, aggregate, collecting, reap, record, recording)
+from apex_tpu.observability.trace import (  # noqa: F401
+    Span, chrome_trace_events, drain_spans, span_recording, spans_enabled)
+from apex_tpu.observability.sinks import (  # noqa: F401
+    ChromeTraceSink, JSONLSink, TensorBoardSink)
+from apex_tpu.observability.report import (  # noqa: F401
+    NullReporter, StepReporter, attach_reporter, detach_reporter,
+    get_reporter)
+from apex_tpu.observability.runtime import (  # noqa: F401
+    install_compile_listeners, sample_memory_stats)

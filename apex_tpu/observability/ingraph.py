@@ -1,0 +1,210 @@
+"""In-graph metric accumulators: device scalars that ride through ``jit``.
+
+Host-side ``Metric.observe()`` cannot run inside a traced step — and a
+per-metric ``device_get`` would stall the XLA pipeline exactly the way the
+reference's per-iteration overflow ``.item()`` sync does
+(``reference:apex/amp/scaler.py:199-200``). The in-graph variant keeps the
+whole protocol on device:
+
+- instrumented code (amp scaler, DDP allreduce, pipeline schedules,
+  optimizers) calls :func:`record(name, value, reduce=...)` with traced
+  scalars;
+- a reaping wrapper (:func:`reap` / :func:`collecting`) collects everything
+  recorded during the trace into a :class:`Metrics` pytree that the step
+  function returns as an extra output — a handful of device scalars, no
+  host round-trip inside the step;
+- :func:`aggregate` reduces each entry across mesh axes with
+  ``psum``/``pmean``/``pmax`` according to its declared reduction, so
+  per-rank values become mesh totals *inside* ``shard_map`` and cross the
+  boundary replicated (``out_specs=P()``);
+- the :class:`~apex_tpu.observability.report.StepReporter` fetches the
+  final pytree once per report.
+
+**Zero-cost default.** :func:`record` checks a module-level collector stack
+at *trace time*: with no collector active it returns before touching its
+arguments, so instrumented paths add no ops, no collectives, and no extra
+outputs to the compiled program (asserted by
+``tests/test_observability.py``). Expensive instrumentation values should
+be passed as thunks — ``record("optim/grad_norm", lambda: global_norm(g))``
+— so the value is only computed when telemetry is on.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Metrics", "record", "recording", "reap", "collecting",
+           "aggregate", "REDUCTIONS"]
+
+REDUCTIONS = ("sum", "mean", "max", "min")
+
+
+@jax.tree_util.register_pytree_node_class
+class Metrics:
+    """An ordered ``{name: device scalar}`` mapping plus the static
+    per-name reduction modes. Registered as a pytree so it crosses
+    ``jit``/``shard_map`` boundaries (a prefix ``P()`` out_spec covers all
+    leaves); the modes travel in the static treedef, which also means two
+    steps recording the same names hit the same compilation cache entry.
+    """
+
+    def __init__(self, values: Optional[Dict[str, Any]] = None,
+                 modes: Optional[Dict[str, str]] = None):
+        self.values: Dict[str, Any] = dict(values or {})
+        self.modes: Dict[str, str] = {k: (modes or {}).get(k, "mean")
+                                      for k in self.values}
+
+    def tree_flatten(self):
+        keys = tuple(sorted(self.values))
+        return [self.values[k] for k in keys], (
+            keys, tuple(self.modes[k] for k in keys))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        keys, modes = aux
+        return cls(dict(zip(keys, children)), dict(zip(keys, modes)))
+
+    def __len__(self):
+        return len(self.values)
+
+    def __contains__(self, name):
+        return name in self.values
+
+    def __getitem__(self, name):
+        return self.values[name]
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self.values)
+
+    def as_floats(self) -> Dict[str, float]:
+        """One transfer for the whole pytree, then plain floats."""
+        host = jax.device_get(self.values)
+        return {k: float(v) for k, v in host.items()}
+
+    def __repr__(self):
+        return f"Metrics({sorted(self.values)})"
+
+
+class _Collector:
+    def __init__(self):
+        self.values: Dict[str, Any] = {}
+        self.modes: Dict[str, str] = {}
+
+    def add(self, name: str, value: Any, mode: str) -> None:
+        prev_mode = self.modes.get(name)
+        if prev_mode is not None and prev_mode != mode:
+            raise ValueError(
+                f"metric {name!r} recorded with reduce={mode!r} but was "
+                f"previously recorded with reduce={prev_mode!r}")
+        value = jnp.asarray(value, jnp.float32)
+        if value.ndim:
+            raise ValueError(
+                f"in-graph metrics must be scalars; {name!r} got shape "
+                f"{value.shape}")
+        if name in self.values and mode == "sum":
+            value = self.values[name] + value
+        # non-sum re-records overwrite: last observation wins
+        self.values[name] = value
+        self.modes[name] = mode
+
+    def freeze(self) -> Metrics:
+        return Metrics(self.values, self.modes)
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_STATE = _State()
+
+
+def recording() -> bool:
+    """True when a collector is open — i.e. instrumentation is live for
+    the code currently being traced/executed. Guard *computations* done
+    only for telemetry with this (or pass a thunk to :func:`record`)."""
+    return bool(_STATE.stack)
+
+
+def record(name: str, value: Union[Any, Callable[[], Any]],
+           reduce: str = "mean") -> None:
+    """Record a named scalar into the innermost open collector.
+
+    No-op (before evaluating ``value``, which may be a thunk) when no
+    collector is open. ``reduce`` declares how :func:`aggregate` combines
+    per-rank values across the mesh: ``"sum"`` for additive quantities
+    (bytes, skip counts), ``"mean"`` for replicated or averaged gauges,
+    ``"max"``/``"min"`` for extrema. Re-recording a name in one step sums
+    for ``"sum"`` mode and overwrites otherwise.
+    """
+    if not _STATE.stack:
+        return
+    if reduce not in REDUCTIONS:
+        raise ValueError(f"unknown reduction {reduce!r}; "
+                         f"expected one of {REDUCTIONS}")
+    if callable(value):
+        value = value()
+    _STATE.stack[-1].add(name, value, reduce)
+
+
+@contextlib.contextmanager
+def collecting():
+    """Open a collector around a region of traced code; yields the
+    collector whose ``freeze()`` returns the :class:`Metrics` pytree.
+    The collector MUST be frozen at the same trace level it was filled
+    (inside the same ``shard_map``/``jit`` body), or the recorded tracers
+    leak."""
+    col = _Collector()
+    _STATE.stack.append(col)
+    try:
+        yield col
+    finally:
+        popped = _STATE.stack.pop()
+        assert popped is col
+
+
+def reap(fn: Callable) -> Callable:
+    """Wrap ``fn`` so it returns ``(out, Metrics)`` with everything
+    recorded during its evaluation. Wrap at the trace level where the
+    records happen — for shard_mapped steps, the *inner* function."""
+
+    def wrapped(*args, **kwargs):
+        with collecting() as col:
+            out = fn(*args, **kwargs)
+            metrics = col.freeze()
+        return out, metrics
+
+    return wrapped
+
+
+def _cast_varying(x, axes: Tuple[str, ...]):
+    # On VMA jax a replicated-typed value cannot feed psum directly; mark
+    # it varying first (value identity; no-op on pre-VMA jax). Imported
+    # lazily: utils.vma pulls in the whole utils package.
+    from apex_tpu.utils.vma import cast_to_vma
+    return cast_to_vma(x, frozenset(axes))
+
+
+def aggregate(metrics: Metrics,
+              axis_names: Union[None, str, Sequence[str]]) -> Metrics:
+    """Reduce every entry across the given bound mesh axes according to its
+    declared reduction. Call inside ``shard_map`` (axes bound); the result
+    is replicated, so it can cross a ``P()`` out_spec. With ``None``/empty
+    axes this is the identity (single-process, no mesh)."""
+    if not axis_names:
+        return metrics
+    if isinstance(axis_names, str):
+        axis_names = (axis_names,)
+    axes = tuple(axis_names)
+    reducers = {"sum": jax.lax.psum, "mean": jax.lax.pmean,
+                "max": jax.lax.pmax, "min": jax.lax.pmin}
+    out = {}
+    for name, value in metrics.values.items():
+        out[name] = reducers[metrics.modes[name]](
+            _cast_varying(value, axes), axes)
+    return Metrics(out, dict(metrics.modes))

@@ -1,0 +1,210 @@
+"""Host-side metrics registry: counters, gauges, fixed-bucket histograms.
+
+These are plain Python accumulators for *host-observed* quantities —
+compile counts, queue depths, sampled device memory — the complement of the
+in-graph accumulators (:mod:`~apex_tpu.observability.ingraph`) that live
+inside the traced step. A :class:`MetricsRegistry` is a named collection
+whose :meth:`~MetricsRegistry.snapshot` flattens everything to
+``{name: float}`` for the sinks; the module-level default registry
+(:func:`get_registry`) is what the pre-wired runtime listeners write to.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "get_registry", "DEFAULT_BUCKETS"]
+
+# power-of-4 spread from sub-millisecond to minutes — wide enough for both
+# durations (seconds) and sizes (use explicit buckets for bytes)
+DEFAULT_BUCKETS: Tuple[float, ...] = tuple(4.0 ** e for e in range(-6, 6))
+
+
+class Metric:
+    """Base: a named observable. ``observe`` is the uniform write API so
+    call sites can hold any metric kind."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def observe(self, value: float) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def snapshot(self) -> Dict[str, float]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def reset(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    """Monotonic accumulator. ``observe(v)`` adds ``v`` (default usage is
+    :meth:`inc`)."""
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._value += amount
+
+    observe = inc
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot(self) -> Dict[str, float]:
+        return {self.name: self._value}
+
+    def reset(self) -> None:
+        self._value = 0.0
+
+
+class Gauge(Metric):
+    """Last-value metric. ``observe``/``set`` overwrite."""
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self._value = math.nan
+
+    def set(self, value: float) -> None:
+        self._value = float(value)
+
+    observe = set
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot(self) -> Dict[str, float]:
+        return {self.name: self._value}
+
+    def reset(self) -> None:
+        self._value = math.nan
+
+
+class Histogram(Metric):
+    """Fixed-bucket histogram (Prometheus-style cumulative ``le`` buckets).
+
+    ``observe(v)`` increments the first bucket whose upper bound admits
+    ``v``; the snapshot carries per-bucket counts plus ``_count``/``_sum``
+    so sinks can derive means without keeping samples.
+    """
+
+    def __init__(self, name: str,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name)
+        bounds = sorted(float(b) for b in buckets)
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.bounds: Tuple[float, ...] = tuple(bounds)
+        self._counts = [0] * (len(bounds) + 1)  # +1 = overflow (+inf)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self._counts[bisect.bisect_left(self.bounds, value)] += 1
+        self._sum += value
+        self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def bucket_counts(self) -> Dict[str, int]:
+        """Cumulative counts, honoring the Prometheus ``le`` contract:
+        ``..._bucket_le_B`` is the number of samples ``<= B``, and
+        ``le_inf`` equals ``count``."""
+        out = {}
+        running = 0
+        for bound, c in zip(self.bounds, self._counts):
+            running += c
+            out[f"{self.name}_bucket_le_{bound:g}"] = running
+        out[f"{self.name}_bucket_le_inf"] = running + self._counts[-1]
+        return out
+
+    def snapshot(self) -> Dict[str, float]:
+        out: Dict[str, float] = {f"{self.name}_count": float(self._count),
+                                 f"{self.name}_sum": self._sum}
+        out.update({k: float(v) for k, v in self.bucket_counts().items()})
+        return out
+
+    def reset(self) -> None:
+        self._counts = [0] * (len(self.bounds) + 1)
+        self._sum = 0.0
+        self._count = 0
+
+
+class MetricsRegistry:
+    """Named collection with get-or-create accessors.
+
+    Re-requesting a name returns the existing metric; requesting it as a
+    different kind raises — a name means one thing for the whole run.
+    """
+
+    def __init__(self):
+        self._metrics: Dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, name: str, kind, factory) -> Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = factory()
+            elif not isinstance(m, kind):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {kind.__name__}")
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get_or_create(name, Counter, lambda: Counter(name))
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get_or_create(name, Gauge, lambda: Gauge(name))
+
+    def histogram(self, name: str,
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(name, Histogram,
+                                   lambda: Histogram(name, buckets))
+
+    def names(self) -> Iterable[str]:
+        return tuple(self._metrics)
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat ``{name: value}`` over every registered metric; NaN gauges
+        (never set) are skipped so sinks don't emit noise."""
+        out: Dict[str, float] = {}
+        for m in self._metrics.values():
+            for k, v in m.snapshot().items():
+                if isinstance(m, Gauge) and math.isnan(v):
+                    continue
+                out[k] = v
+        return out
+
+    def reset(self) -> None:
+        for m in self._metrics.values():
+            m.reset()
+
+
+_DEFAULT: Optional[MetricsRegistry] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide default registry (created on first use)."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = MetricsRegistry()
+        return _DEFAULT

@@ -1,0 +1,153 @@
+"""StepReporter: one snapshot per training step into pluggable sinks.
+
+The reporter is the host-side half of the telemetry loop. Each
+:meth:`~StepReporter.report` merges, in one payload:
+
+- the step's in-graph :class:`~apex_tpu.observability.ingraph.Metrics`
+  (already mesh-aggregated device scalars — fetched with ONE transfer);
+- the host :class:`~apex_tpu.observability.registry.MetricsRegistry`
+  snapshot (compile counters, sampled memory gauges, ...);
+- per-timer elapsed milliseconds from a ``Timers`` group
+  (``time/<name>_ms``), the ``_Timers.write`` role
+  (``reference:apex/transformer/pipeline_parallel/_timers.py:66-75``);
+
+and emits it to every sink, together with any captured timer spans.
+
+The module-level default is a :class:`NullReporter`, so library code and
+training loops can call ``get_reporter().report(...)`` unconditionally at
+zero cost; :func:`attach_reporter` swaps the real one in.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Sequence
+
+from apex_tpu.observability import trace
+from apex_tpu.observability.ingraph import Metrics
+from apex_tpu.observability.registry import MetricsRegistry, get_registry
+from apex_tpu.observability.sinks import Sink
+
+__all__ = ["StepReporter", "NullReporter", "attach_reporter",
+           "detach_reporter", "get_reporter"]
+
+
+class StepReporter:
+    """Snapshot registry + timers + in-graph metrics into sinks.
+
+    ``interval`` reports every Nth step (others are dropped without
+    fetching, so a tight loop can call ``report`` every step and pay the
+    device transfer only when something is emitted). ``capture_spans``
+    turns on ``Timer`` span capture for the reporter's lifetime so a
+    :class:`~apex_tpu.observability.sinks.ChromeTraceSink` sees them.
+    """
+
+    def __init__(self, sinks: Sequence[Sink],
+                 registry: Optional[MetricsRegistry] = None,
+                 timers=None, interval: int = 1,
+                 capture_spans: bool = False):
+        if interval < 1:
+            raise ValueError("interval must be >= 1")
+        self.sinks = list(sinks)
+        self.registry = registry if registry is not None else get_registry()
+        self.timers = timers
+        self.interval = interval
+        self._capture_spans = capture_spans
+        if capture_spans:
+            trace.enable_spans()
+
+    def _timer_payload(self, reset: bool) -> Dict[str, float]:
+        if self.timers is None:
+            return {}
+        out = {}
+        for name, t in self.timers.timers.items():
+            if t.started_:  # snapshot mid-flight without perturbing it
+                continue
+            out[f"time/{name}_ms"] = t.elapsed(reset=reset) * 1e3
+        return out
+
+    def report(self, step: int, metrics: Optional[Metrics] = None,
+               extra: Optional[Dict[str, float]] = None,
+               reset_timers: bool = True) -> Optional[Dict[str, float]]:
+        """Emit one payload; returns it (None on off-interval steps).
+
+        ``metrics`` is the step's in-graph pytree (or a plain dict of
+        device/host scalars); ``extra`` merges host-side one-offs (e.g.
+        the loss you already fetched for logging).
+        """
+        if step % self.interval:
+            return None
+        payload: Dict[str, float] = {}
+        if metrics is not None:
+            if isinstance(metrics, Metrics):
+                payload.update(metrics.as_floats())
+            else:
+                payload.update({k: float(v) for k, v in metrics.items()})
+        payload.update(self.registry.snapshot())
+        payload.update(self._timer_payload(reset=reset_timers))
+        if extra:
+            payload.update({k: float(v) for k, v in extra.items()})
+        spans = trace.drain_spans() if trace.spans_enabled() else []
+        for sink in self.sinks:
+            sink.emit(step, payload, spans)
+        return payload
+
+    def close(self) -> None:
+        if self._capture_spans:
+            trace.disable_spans()
+        for sink in self.sinks:
+            sink.close()
+        # a closed reporter must not stay the process default: later
+        # get_reporter().report(...) calls would write to closed sinks
+        if _ACTIVE is self:
+            detach_reporter()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class NullReporter:
+    """The module-level no-op default: accepts the full reporter surface,
+    does nothing, costs a method call."""
+
+    sinks: tuple = ()
+    interval = 1
+
+    def report(self, step, metrics=None, extra=None, reset_timers=True):
+        return None
+
+    def close(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+    def __bool__(self):
+        return False  # `if get_reporter():` reads naturally
+
+
+_NULL = NullReporter()
+_ACTIVE = _NULL
+
+
+def get_reporter():
+    """The attached reporter, or the no-op default."""
+    return _ACTIVE
+
+
+def attach_reporter(reporter: StepReporter):
+    """Install ``reporter`` as the process-wide default; returns it so
+    ``with attach_reporter(StepReporter(...)):`` works."""
+    global _ACTIVE
+    _ACTIVE = reporter
+    return reporter
+
+
+def detach_reporter() -> None:
+    global _ACTIVE
+    _ACTIVE = _NULL

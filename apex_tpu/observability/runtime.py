@@ -1,0 +1,97 @@
+"""Runtime introspection: compile/recompile counters and memory gauges.
+
+Two classes of regressions are invisible in a loss curve until they ruin a
+run: a *recompilation storm* (a shape or static-arg leak retracing the
+step every iteration) and *HBM growth* (fragmentation or a leaked
+reference creeping toward OOM). Both have first-class signals in JAX:
+
+- ``jax.monitoring`` events — every trace/lower/backend-compile records a
+  duration event; :func:`install_compile_listeners` turns them into
+  registry counters (``jax/compiles``, ``jax/traces``) and a compile-time
+  histogram, so ``jax/compiles`` climbing after warmup IS the storm;
+- ``Device.memory_stats()`` — :func:`sample_memory_stats` snapshots
+  ``bytes_in_use``/``peak_bytes_in_use`` per local device into gauges
+  (skipping backends that expose no stats, e.g. CPU).
+
+Both write to the default registry, so an attached
+:class:`~apex_tpu.observability.report.StepReporter` folds them into the
+same per-step stream as the in-graph metrics.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+
+from apex_tpu.observability.registry import MetricsRegistry, get_registry
+
+__all__ = ["install_compile_listeners", "sample_memory_stats"]
+
+# jax.monitoring event suffixes -> counter names. Matched by suffix so the
+# '/jax/core/compile/...' prefix may move between jax versions without
+# silently zeroing the counters.
+_DURATION_COUNTERS = {
+    "backend_compile_duration": "jax/compiles",
+    "jaxpr_trace_duration": "jax/traces",
+}
+
+_installed_registries = []
+
+
+def install_compile_listeners(
+        registry: Optional[MetricsRegistry] = None) -> MetricsRegistry:
+    """Register ``jax.monitoring`` listeners feeding ``registry``.
+
+    Idempotent per registry (``jax.monitoring`` offers no per-listener
+    deregistration, so double-installing would double-count). Returns the
+    registry for chaining.
+    """
+    reg = registry if registry is not None else get_registry()
+    if any(r is reg for r in _installed_registries):
+        return reg
+    _installed_registries.append(reg)
+
+    compile_s = reg.histogram("jax/compile_seconds")
+    counters = {suffix: reg.counter(name)
+                for suffix, name in _DURATION_COUNTERS.items()}
+    compiles = counters["backend_compile_duration"]
+
+    def on_duration(event: str, duration: float, **kw) -> None:
+        for suffix, counter in counters.items():
+            if event.endswith(suffix):
+                counter.inc()
+                if counter is compiles:
+                    compile_s.observe(duration)
+
+    jax.monitoring.register_event_duration_secs_listener(on_duration)
+    return reg
+
+
+_MEM_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+
+def sample_memory_stats(
+        registry: Optional[MetricsRegistry] = None) -> Dict[str, float]:
+    """Gauge-sample allocator stats from every local device.
+
+    Returns (and stores in ``registry``) ``memory/<key>/device<i>`` for
+    each stat the backend exposes; backends without ``memory_stats()``
+    (CPU) contribute nothing. Call once per report interval — it is a
+    host-side query, not a device sync.
+    """
+    reg = registry if registry is not None else get_registry()
+    out: Dict[str, float] = {}
+    for i, dev in enumerate(jax.local_devices()):
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        for key in _MEM_KEYS:
+            if key in stats:
+                name = f"memory/{key}/device{i}"
+                reg.gauge(name).set(stats[key])
+                out[name] = float(stats[key])
+    return out

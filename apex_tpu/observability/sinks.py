@@ -1,0 +1,131 @@
+"""Pluggable metric sinks for the :class:`StepReporter`.
+
+A sink receives one flattened ``{name: float}`` payload (plus any timer
+spans) per reported step. Three are provided:
+
+- :class:`JSONLSink` — one JSON object per step, the grep-able event log;
+- :class:`TensorBoardSink` — adapter onto any object with
+  ``add_scalar(tag, value, step)``, the writer protocol ``Timers.write``
+  already targets (``reference:apex/transformer/pipeline_parallel/
+  _timers.py:66-75``), so a real SummaryWriter drops in unchanged;
+- :class:`ChromeTraceSink` — accumulates timer spans (and per-step metric
+  counter tracks) into a ``chrome://tracing`` / Perfetto-loadable JSON.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import time
+from typing import Dict, Iterable, Optional, Sequence, Union
+
+from apex_tpu.observability.trace import Span, chrome_trace_events
+
+__all__ = ["Sink", "JSONLSink", "TensorBoardSink", "ChromeTraceSink"]
+
+
+class Sink:
+    """Interface: ``emit`` once per reported step, ``close`` at shutdown."""
+
+    def emit(self, step: int, metrics: Dict[str, float],
+             spans: Sequence[Span] = ()) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class JSONLSink(Sink):
+    """One ``{"step", "time", "metrics"}`` JSON line per report.
+
+    Accepts a path (opened append, crash-durable via line-buffered flush)
+    or any text file-like (e.g. ``io.StringIO`` in tests, ``sys.stdout``
+    for the reference's print-style visibility done structurally).
+    """
+
+    def __init__(self, path_or_file: Union[str, os.PathLike, io.TextIOBase]):
+        if isinstance(path_or_file, (str, os.PathLike)):
+            self._file = open(path_or_file, "a")
+            self._owns = True
+        else:
+            self._file = path_or_file
+            self._owns = False
+
+    def emit(self, step, metrics, spans=()):
+        self._file.write(json.dumps(
+            {"step": int(step), "time": time.time(),
+             "metrics": {k: metrics[k] for k in sorted(metrics)}})
+            + "\n")
+        self._file.flush()
+
+    def close(self):
+        if self._owns:
+            self._file.close()
+
+
+class TensorBoardSink(Sink):
+    """Fan a payload out as ``writer.add_scalar(name, value, step)``."""
+
+    def __init__(self, writer):
+        if not hasattr(writer, "add_scalar"):
+            raise TypeError("TensorBoardSink needs an object with "
+                            "add_scalar(tag, value, step)")
+        self.writer = writer
+
+    def emit(self, step, metrics, spans=()):
+        for name in sorted(metrics):
+            self.writer.add_scalar(name, metrics[name], step)
+
+    def close(self):
+        flush = getattr(self.writer, "flush", None)
+        if flush is not None:
+            flush()
+
+
+class ChromeTraceSink(Sink):
+    """Accumulate spans into Chrome-trace JSON, written on ``close``.
+
+    Metric payloads are also emitted as counter events (``ph="C"``) so
+    scalar series render as tracks under the spans in Perfetto. ``pid`` is
+    the JAX process index by default, separating hosts in a multi-process
+    capture.
+    """
+
+    def __init__(self, path: Union[str, os.PathLike],
+                 pid: Optional[int] = None,
+                 counters: Union[bool, Iterable[str]] = True):
+        self.path = os.fspath(path)
+        if pid is None:
+            try:
+                import jax
+                pid = jax.process_index()
+            except Exception:
+                pid = 0
+        self.pid = pid
+        self._counters = counters
+        self._events = []
+
+    def emit(self, step, metrics, spans=()):
+        self._events.extend(
+            chrome_trace_events(spans, pid=self.pid, step=step))
+        if self._counters and metrics:
+            names = (sorted(metrics) if self._counters is True
+                     else [n for n in self._counters if n in metrics])
+            ts = time.perf_counter() * 1e6
+            for name in names:
+                self._events.append(
+                    {"name": name, "ph": "C", "cat": "apex_tpu",
+                     "ts": ts, "pid": self.pid,
+                     "args": {name: metrics[name]}})
+
+    def close(self):
+        with open(self.path, "w") as f:
+            json.dump({"traceEvents": self._events,
+                       "displayTimeUnit": "ms"}, f)

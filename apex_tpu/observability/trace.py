@@ -1,0 +1,101 @@
+"""Wall-clock span capture + Chrome-trace (Perfetto-loadable) conversion.
+
+:class:`~apex_tpu.utils.timers.Timer` records *elapsed totals*; the trace
+viewers want *spans*. When span recording is enabled, every ``Timer.stop``
+pushes ``(name, t0, t1)`` here via a hook installed into
+``apex_tpu.utils.timers`` (a plain module-global check — one ``is None``
+test per stop when disabled, and no import cycle: this module imports
+nothing from the rest of the library). The
+:class:`~apex_tpu.observability.sinks.ChromeTraceSink` drains the buffer
+each report and writes the standard ``traceEvents`` JSON, which loads in
+``chrome://tracing`` / Perfetto next to a ``jax.profiler.trace`` capture —
+host-side step phases and device-side ops in the same timeline workflow.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import List, NamedTuple, Optional
+
+__all__ = ["Span", "spans_enabled", "enable_spans", "disable_spans",
+           "record_span", "drain_spans", "span_recording",
+           "chrome_trace_events"]
+
+
+class Span(NamedTuple):
+    name: str
+    start: float  # perf_counter seconds
+    end: float
+
+
+_LOCK = threading.Lock()
+_SPANS: List[Span] = []
+_ENABLED = False
+
+
+def spans_enabled() -> bool:
+    return _ENABLED
+
+
+def record_span(name: str, start: float, end: float) -> None:
+    if not _ENABLED:
+        return
+    with _LOCK:
+        _SPANS.append(Span(name, start, end))
+
+
+def _install_timer_hook(on: bool) -> None:
+    from apex_tpu.utils import timers
+    timers.set_span_hook(record_span if on else None)
+
+
+def enable_spans() -> None:
+    global _ENABLED
+    _ENABLED = True
+    _install_timer_hook(True)
+
+
+def disable_spans() -> None:
+    global _ENABLED
+    _ENABLED = False
+    _install_timer_hook(False)
+    # drop undrained spans: a later session must not inherit them (and
+    # mislabel them with its own step numbers)
+    with _LOCK:
+        _SPANS.clear()
+
+
+def drain_spans() -> List[Span]:
+    with _LOCK:
+        out = list(_SPANS)
+        _SPANS.clear()
+    return out
+
+
+@contextlib.contextmanager
+def span_recording():
+    """Enable span capture for a region (e.g. the whole training loop)."""
+    was = _ENABLED
+    enable_spans()
+    try:
+        yield
+    finally:
+        if not was:
+            disable_spans()
+
+
+def chrome_trace_events(spans, pid: int = 0, tid: int = 0,
+                        step: Optional[int] = None) -> List[dict]:
+    """Convert spans to Chrome-trace complete events (``ph="X"``, micro-
+    second timestamps). ``step``, when given, lands in ``args`` so the
+    viewer can filter by training step."""
+    events = []
+    for s in spans:
+        ev = {"name": s.name, "ph": "X", "cat": "apex_tpu",
+              "ts": s.start * 1e6, "dur": (s.end - s.start) * 1e6,
+              "pid": pid, "tid": tid}
+        if step is not None:
+            ev["args"] = {"step": step}
+        events.append(ev)
+    return events

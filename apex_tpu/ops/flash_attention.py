@@ -435,7 +435,8 @@ def _sds(shape, dtype, like):
     """ShapeDtypeStruct carrying the varying-manual-axes of ``like`` — a
     pallas_call inside ``shard_map`` (check_vma) must declare how its
     outputs vary; they vary exactly like the q/k/v operands."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    from apex_tpu.utils.vma import leaf_vma
+    vma = leaf_vma(like)
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

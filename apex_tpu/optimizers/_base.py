@@ -32,9 +32,20 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.amp.scaler import select_tree
+from apex_tpu.observability import ingraph as _metrics
 
 __all__ = ["OptimizerBase", "tree_unzip", "tree_zeros_like_f32",
-           "bias_correction"]
+           "bias_correction", "global_grad_norm"]
+
+
+def global_grad_norm(grads: Any) -> jnp.ndarray:
+    """Global L2 norm over every floating leaf, accumulated in fp32 — the
+    quantity the reference's LAMB global grad-norm clip computes
+    (``reference:apex/optimizers/fused_lamb.py:124-133``). Delegates to
+    the shared :func:`~apex_tpu.multi_tensor_apply.tree_global_norm`."""
+    from apex_tpu.multi_tensor_apply.multi_tensor_apply import (
+        tree_global_norm)
+    return tree_global_norm(grads)
 
 
 def tree_unzip(out: Any, treedef, k: int) -> Tuple[Any, ...]:
@@ -68,6 +79,10 @@ class OptimizerBase:
 
     def step(self, grads: Any, state: Any, params: Any,
              grads_finite: Optional[jnp.ndarray] = None, **kw) -> Tuple[Any, Any]:
+        # thunked: the norm reduction is only added to the program when a
+        # telemetry collector is active
+        _metrics.record("optim/grad_norm",
+                        lambda: global_grad_norm(grads), reduce="mean")
         new_params, new_state = self._step(grads, state, params, **kw)
         if grads_finite is None:
             return new_params, new_state

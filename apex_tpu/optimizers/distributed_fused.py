@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from apex_tpu.optimizers._base import OptimizerBase, bias_correction
 from apex_tpu.optimizers._flatten import (FlatLayout, build_layout, ravel,
                                           segment_ids, unravel)
+from apex_tpu.utils.compat import axis_size as _axis_size
 
 __all__ = ["DistributedFusedAdam", "DistributedFusedLAMB",
            "ZeroAdamState", "ZeroLambState"]
@@ -78,7 +79,7 @@ class _DistributedFusedBase(OptimizerBase):
         return lay.padded // lay.chunk
 
     def _layout_for(self, params: Any) -> FlatLayout:
-        lay = build_layout(params, chunks=jax.lax.axis_size(self.axis_name))
+        lay = build_layout(params, chunks=_axis_size(self.axis_name))
         if self._layout is not None and (
                 self._layout.shapes != lay.shapes
                 or self._layout.chunk != lay.chunk):

@@ -27,7 +27,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu.observability import ingraph as _metrics
 from apex_tpu.utils.vma import cast_to_vma
+from apex_tpu.utils.compat import axis_size as _axis_size
 
 __all__ = ["allreduce_grads", "DistributedDataParallel", "Reducer",
            "grouped_psum"]
@@ -61,7 +63,7 @@ def grouped_psum(x: jnp.ndarray, axis_name: str,
         return jax.lax.psum(x, axis_name, axis_index_groups=groups)
     except NotImplementedError:
         pass
-    world = jax.lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     gathered = jax.lax.all_gather(x, axis_name)  # (world, ...)
 
@@ -89,7 +91,7 @@ def grouped_psum(x: jnp.ndarray, axis_name: str,
 def _group_size_for_rank(axis_name: str, groups) -> jnp.ndarray:
     """Traced size of the group containing this rank — groups may be uneven,
     so averaging must use each rank's own group size."""
-    world = jax.lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     sizes = np.zeros((world,), np.float32)
     for g in groups:
         for i in g:
@@ -113,8 +115,19 @@ def allreduce_grads(grads: Any, axis_name: str = "data",
     if axis_index_groups is not None:
         world = _group_size_for_rank(axis_name, axis_index_groups)
     else:
-        world = jax.lax.axis_size(axis_name)
+        world = _axis_size(axis_name)
     pre = gradient_predivide_factor
+
+    if _metrics.recording():
+        # shapes/dtypes are static, so the reduced traffic is a trace-time
+        # constant: this rank's contribution per sync (DDP "bucket" = one
+        # leaf = one psum; XLA may coalesce, this counts the semantic view)
+        leaves = [jnp.asarray(g) for g in jax.tree_util.tree_leaves(grads)]
+        nbytes = sum(
+            l.size * (4 if allreduce_always_fp32 else l.dtype.itemsize)
+            for l in leaves)
+        _metrics.record("ddp/allreduce_bytes", float(nbytes), reduce="sum")
+        _metrics.record("ddp/buckets", float(len(leaves)), reduce="mean")
 
     @jax.named_scope("apex_ddp_allreduce")
     def _sync(g):

@@ -20,6 +20,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from apex_tpu.utils.compat import axis_size as _axis_size
 
 __all__ = ["halo_exchange", "spatial_conv2d"]
 
@@ -36,7 +37,7 @@ def halo_exchange(x: jnp.ndarray, axis_name: str, halo: int = 1,
     individually (strided SAME convs pad asymmetrically)."""
     ht = halo if halo_top is None else halo_top
     hb = halo if halo_bottom is None else halo_bottom
-    cp = jax.lax.axis_size(axis_name)
+    cp = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     fwd = [(i, (i + 1) % cp) for i in range(cp)]
     bwd = [(i, (i - 1) % cp) for i in range(cp)]

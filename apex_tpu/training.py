@@ -13,16 +13,18 @@ from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.config import TrainConfig
+from apex_tpu.observability import ingraph
 from apex_tpu.optimizers import AdamState
 from apex_tpu.optimizers.distributed_fused import (_DistributedFusedBase,
                                                    ZeroAdamState)
+from apex_tpu.parallel.distributed import allreduce_grads
 from apex_tpu.transformer.amp import GradScaler
 from apex_tpu.transformer.pipeline_parallel import (
     forward_backward_pipelining_without_interleaving)
+from apex_tpu.utils.compat import shard_map_unchecked
 from apex_tpu.utils.vma import cast_to_vma
 
 __all__ = ["GPTHybridTrainer"]
@@ -71,7 +73,7 @@ class GPTHybridTrainer:
             def init_inner(stage_stack, shared):
                 return opt.init((stage_stack, shared))
 
-            opt_state = jax.jit(shard_map(
+            opt_state = jax.jit(shard_map_unchecked(
                 init_inner, mesh=self.mesh,
                 in_specs=(sspec, self.shared_specs),
                 out_specs=self._zero_state_spec()))(stage_stack, shared)
@@ -108,14 +110,33 @@ class GPTHybridTrainer:
     # -- the step ---------------------------------------------------------
     def train_step(self, stage_stack, shared, opt_state, ls, tokens,
                    targets):
+        return self._step_impl(False, stage_stack, shared, opt_state, ls,
+                               tokens, targets)
+
+    def train_step_with_metrics(self, stage_stack, shared, opt_state, ls,
+                                tokens, targets):
+        """:meth:`train_step` plus the step's telemetry: returns
+        ``(loss, stage_stack, shared, opt_state, ls, metrics)`` where
+        ``metrics`` is an
+        :class:`~apex_tpu.observability.ingraph.Metrics` pytree of device
+        scalars (``amp/*``, ``ddp/*``, ``pipeline/*``, ``optim/*``),
+        already psum/pmean-aggregated over the whole mesh — hand it to a
+        :class:`~apex_tpu.observability.report.StepReporter`. Compiles a
+        separate program from :meth:`train_step`; the uninstrumented step
+        stays byte-identical."""
+        return self._step_impl(True, stage_stack, shared, opt_state, ls,
+                               tokens, targets)
+
+    def _step_impl(self, with_metrics, stage_stack, shared, opt_state, ls,
+                   tokens, targets):
         model, opt, scaler, pp = self.model, self.opt, self.scaler, self.pp
 
-        def inner(stage_stack, shared, opt_state, ls, tokens, targets):
+        def body(stage_stack, shared, opt_state, ls, tokens, targets):
             # rebuild the pipeline closures over THIS dp-rank's targets
             stage, embed_fn, head_fn, _, _ = model.pipeline_fns(pp, targets)
             # DDP pattern: params enter the differentiated region
             # data-VARYING so AD yields per-replica grads, averaged
-            # explicitly below (pmean = the reference DDP allreduce)
+            # explicitly below (the instrumented DDP allreduce)
             vary = lambda t: jax.tree_util.tree_map(
                 lambda x: cast_to_vma(x, frozenset({"data"})), t)
             my_stage = vary(jax.tree_util.tree_map(
@@ -127,8 +148,7 @@ class GPTHybridTrainer:
                     grad_scale=ls.loss_scale)
             grads = (jax.tree_util.tree_map(lambda g: g[None], sg), shg)
             if not self.is_zero:
-                grads = jax.tree_util.tree_map(
-                    lambda g: jax.lax.pmean(g, "data"), grads)
+                grads = allreduce_grads(grads, "data")
             # (ZeRO: the optimizer's psum_scatter/dp IS the DDP mean —
             # reduce_scatter replaces the allreduce, the ZeRO comm win)
             if self.is_zero:
@@ -148,11 +168,25 @@ class GPTHybridTrainer:
             return (jax.lax.pmean(loss, "data"), new_p[0], new_p[1],
                     new_s, new_ls)
 
+        if with_metrics:
+            def inner(*args):
+                # reap INSIDE shard_map: the recorded scalars live at this
+                # trace level; aggregation over every mesh axis makes them
+                # replicated, so a prefix P() out_spec carries them out
+                out, metrics = ingraph.reap(body)(*args)
+                return out + (ingraph.aggregate(
+                    metrics, tuple(self.mesh.axis_names)),)
+        else:
+            inner = body
+
         sspec = self.stage_specs(stage_stack)
         _, shspec, ospec, lspec = self.state_specs(stage_stack)
-        return shard_map(
+        out_specs = (P(), sspec, shspec, ospec, lspec)
+        if with_metrics:
+            out_specs = out_specs + (P(),)
+        return shard_map_unchecked(
             inner, mesh=self.mesh,
             in_specs=(sspec, shspec, ospec, lspec,
                       P(None, "data"), P(None, "data")),
-            out_specs=(P(), sspec, shspec, ospec, lspec))(
+            out_specs=out_specs)(
                 stage_stack, shared, opt_state, ls, tokens, targets)

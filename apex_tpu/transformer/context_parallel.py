@@ -35,6 +35,7 @@ import jax.numpy as jnp
 
 from apex_tpu.ops.flash_attention import NEG_INF
 from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.utils.compat import axis_size as _axis_size
 
 __all__ = ["ring_attention", "ulysses_attention",
            "scatter_to_sequence_parallel_region",
@@ -58,7 +59,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     b, h, s_loc, d = q.shape
     if softmax_scale is None:
         softmax_scale = 1.0 / math.sqrt(d)
-    cp = jax.lax.axis_size(axis_name)
+    cp = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 
@@ -137,7 +138,7 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     trade, both offered here.
     """
     b, h_loc_in, s_loc, d = q.shape
-    cp = jax.lax.axis_size(axis_name)
+    cp = _axis_size(axis_name)
     # note: h here is the LOCAL head count of the sequence-sharded layout,
     # which equals the global head count (heads are replicated across cp)
     if h_loc_in % cp:
@@ -175,7 +176,7 @@ def scatter_to_sequence_parallel_region(x: jnp.ndarray,
     Entering an SP region (Megatron-LM ``scatter_to_sequence_parallel``;
     the reference layout is (s, b, h) so ``seq_axis`` defaults to 0 —
     pass 1 for (b, s, h) models)."""
-    tp = jax.lax.axis_size(axis_name)
+    tp = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     if x.shape[seq_axis] % tp:
         raise ValueError(f"sequence dim {x.shape[seq_axis]} not divisible "

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 
 from apex_tpu.transformer.parallel_state import TENSOR_AXIS
 from apex_tpu.transformer.tensor_parallel.layers import init_method_normal
+from apex_tpu.utils.compat import axis_size as _axis_size
 
 __all__ = ["ExpertParallelMLP"]
 
@@ -119,7 +120,7 @@ class ExpertParallelMLP:
     def __call__(self, params: dict, x: jnp.ndarray
                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         E = self.num_experts
-        ep = jax.lax.axis_size(self.axis_name)
+        ep = _axis_size(self.axis_name)
         if E % ep:
             raise ValueError(f"num_experts {E} not divisible by ep={ep}")
         e_loc = E // ep

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.transformer.parallel_state import PIPE_AXIS
+from apex_tpu.utils.compat import axis_size as _axis_size
 
 __all__ = [
     "rotate_forward", "rotate_backward",
@@ -42,14 +43,14 @@ def rotate_forward(x: jnp.ndarray) -> jnp.ndarray:
     """Every stage sends ``x`` to the next stage and receives from the
     previous (wrapping; the wrap value is ignored by stage 0's select in the
     schedules). ``send_forward`` + ``recv_forward`` of the reference."""
-    pp = jax.lax.axis_size(PIPE_AXIS)
+    pp = _axis_size(PIPE_AXIS)
     return jax.lax.ppermute(x, PIPE_AXIS, _perm_next(pp))
 
 
 def rotate_backward(g: jnp.ndarray) -> jnp.ndarray:
     """``send_backward`` + ``recv_backward``: grads flow to the previous
     stage."""
-    pp = jax.lax.axis_size(PIPE_AXIS)
+    pp = _axis_size(PIPE_AXIS)
     return jax.lax.ppermute(g, PIPE_AXIS, _perm_prev(pp))
 
 

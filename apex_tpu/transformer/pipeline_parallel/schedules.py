@@ -44,10 +44,29 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.observability import ingraph as _metrics
 from apex_tpu.transformer.parallel_state import PIPE_AXIS
 from apex_tpu.utils.vma import cast_to_vma
 from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
     rotate_backward, rotate_forward)
+from apex_tpu.utils.compat import axis_size as _axis_size
+
+
+def _record_schedule_metrics(num_microbatches: int, ticks: int,
+                             useful_ticks: int) -> None:
+    """Static schedule shape telemetry (trace-time Python constants — free
+    even when a collector is active, absent when not). ``bubble_fraction``
+    is the analytic idle share of stage time slots: each of ``ticks``
+    slots per stage runs at most one microbatch unit of useful work, of
+    which ``useful_ticks`` are non-bubble — Megatron's (p-1)/(m+p-1) for
+    the forward pipe, (2p-1)/(m+2p-1) for the fwd+bwd 1F1B scan. Per-tick
+    *wall* times are a trace concern: the ``pipeline_tick`` named_scope
+    labels every tick's fusions in a ``profile_trace`` capture."""
+    _metrics.record("pipeline/num_microbatches", float(num_microbatches),
+                    reduce="mean")
+    _metrics.record("pipeline/ticks", float(ticks), reduce="mean")
+    _metrics.record("pipeline/bubble_fraction",
+                    1.0 - useful_ticks / ticks, reduce="mean")
 
 
 
@@ -124,6 +143,9 @@ def forward_backward_no_pipelining(
         return (acc_loss + loss, acc_grads), None
 
     n_micro = jax.tree_util.tree_leaves(batch)[0].shape[0]
+    # pp=1: every tick is useful — reported so the stream's pipeline/*
+    # keys exist across schedule choices
+    _record_schedule_metrics(n_micro, n_micro, n_micro)
     zero_grads = None if forward_only else jax.tree_util.tree_map(
         lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params)
     (total_loss, total_grads), _ = jax.lax.scan(
@@ -188,11 +210,12 @@ def pipelined_apply(
     keep M modest per call (grad-accumulate across calls) or pass
     ``remat=True``.
     """
-    S = jax.lax.axis_size(PIPE_AXIS)
+    S = _axis_size(PIPE_AXIS)
     rank = jax.lax.axis_index(PIPE_AXIS)
     M = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
     L = S * num_chunks
     T = M + L - 1
+    _record_schedule_metrics(M, T, M)
     if embed_fn is None:
         if not isinstance(microbatches, jnp.ndarray):
             raise ValueError(
@@ -253,8 +276,8 @@ def pipelined_apply(
     carry_vma = frozenset({PIPE_AXIS})
     for _ in range(4):
         init = cast_to_vma(zeros, carry_vma)
-        out_vma = jax.eval_shape(
-            lambda b: tick(b, jnp.asarray(0))[0], init).vma
+        out_vma = getattr(jax.eval_shape(
+            lambda b: tick(b, jnp.asarray(0))[0], init), "vma", frozenset())
         if out_vma <= carry_vma:
             break
         carry_vma = carry_vma | out_vma
@@ -336,12 +359,13 @@ def _onef1b_fwd_bwd(stage_fn, loss_fn, params, microbatches, remat,
         raise ValueError(
             "embed_fn takes (shared_params, microbatch); pass the embedding "
             "parameters via shared_params so they are differentiated")
-    S = jax.lax.axis_size(PIPE_AXIS)
+    S = _axis_size(PIPE_AXIS)
     rank = jax.lax.axis_index(PIPE_AXIS)
     M = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
     V = num_chunks
     L = S * V
     T = M + 2 * L - 1
+    _record_schedule_metrics(M, T, M)
     # per-chunk saved-activation window: chunk c's global stages start at
     # c*S, so at most 2(L - c*S) - 1 microbatches are in flight there; an
     # EVEN buffer size keeps the odd-difference collision-safety argument
@@ -595,7 +619,7 @@ def _pipelined_fwd_bwd(stage_fn, loss_fn, stage_params, microbatches,
             # (b) routes the head's shared-param cotangent to rank S-1 only,
             # so the psum below counts it exactly once
             rank = jax.lax.axis_index(PIPE_AXIS)
-            S = jax.lax.axis_size(PIPE_AXIS)
+            S = _axis_size(PIPE_AXIS)
             total = jnp.mean(losses)
             return jax.lax.psum(
                 jnp.where(rank == S - 1, total, jnp.zeros_like(total)),

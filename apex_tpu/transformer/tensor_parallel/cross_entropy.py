@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.utils.compat import axis_size as _axis_size
 
 __all__ = ["vocab_parallel_cross_entropy"]
 
@@ -52,7 +53,7 @@ def vocab_parallel_cross_entropy(vocab_parallel_logits: jnp.ndarray,
     loss = jnp.log(sum_exp) - predicted
     if label_smoothing > 0.0:
         # smoothing term needs mean of all logits: psum of local sums
-        vocab_size = vp * jax.lax.axis_size(TENSOR_AXIS)
+        vocab_size = vp * _axis_size(TENSOR_AXIS)
         mean_logits = (jax.lax.psum(jnp.sum(shifted, axis=-1), TENSOR_AXIS)
                        / vocab_size)
         # loss = (1-s)*nll + s * (log_sum_exp - mean_logits)

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.utils.compat import axis_size as _axis_size
 
 __all__ = [
     "copy_to_tensor_model_parallel_region",
@@ -34,11 +35,11 @@ __all__ = [
 
 
 def _vary(x):
-    """Mark ``x`` device-varying over the tensor axis (idempotent)."""
-    vma = getattr(jax.typeof(x), "vma", frozenset())
-    if TENSOR_AXIS in vma:
-        return x
-    return jax.lax.pcast(x, TENSOR_AXIS, to="varying")
+    """Mark ``x`` device-varying over the tensor axis (idempotent; on
+    pre-VMA jax the cast is an identity and shard_map's own replication
+    rewrite supplies the transpose psum)."""
+    from apex_tpu.utils.vma import cast_to_vma
+    return cast_to_vma(x, frozenset({TENSOR_AXIS}))
 
 
 def copy_to_tensor_model_parallel_region(x):
@@ -54,7 +55,7 @@ def reduce_from_tensor_model_parallel_region(x):
 
 
 def _split_local(x):
-    tp = jax.lax.axis_size(TENSOR_AXIS)
+    tp = _axis_size(TENSOR_AXIS)
     rank = jax.lax.axis_index(TENSOR_AXIS)
     chunk = x.shape[-1] // tp
     return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=-1)

@@ -27,7 +27,11 @@ Hot paths in this library are pre-annotated: DDP gradient allreduce
 (``apex_ddp_allreduce``), SyncBatchNorm stats (``sync_bn_stats``), the
 pipeline tick (``pipeline_tick``), and the flash-attention call
 (``flash_attention``). A captured trace shows these names on the
-corresponding fusions.
+corresponding fusions; ``scripts/check_annotations.py`` statically
+verifies the set. For the structured per-step stream (metrics, not
+traces) see :mod:`apex_tpu.observability` — its ``StepReporter`` can
+snapshot these timers into TensorBoard/JSONL sinks and export their
+start/stop spans as a Chrome trace (``docs/OBSERVABILITY.md``).
 
 Typical workflow::
 
@@ -55,7 +59,19 @@ from typing import Any, Dict, Iterable, Optional
 import jax
 import numpy as np
 
-__all__ = ["Timer", "Timers", "profile_trace", "device_fence"]
+__all__ = ["Timer", "Timers", "profile_trace", "device_fence",
+           "set_span_hook"]
+
+# Installed by apex_tpu.observability.trace when span capture is enabled:
+# a callable (name, t0, t1) fed from every Timer.stop. Kept as a plain
+# module global (not an import of observability) so the default cost is
+# one None check per stop and there is no import cycle.
+_SPAN_HOOK = None
+
+
+def set_span_hook(hook) -> None:
+    global _SPAN_HOOK
+    _SPAN_HOOK = hook
 
 
 def device_fence(tree: Any) -> None:
@@ -91,9 +107,12 @@ class Timer:
         assert self.started_, f"timer {self.name} is not started"
         if wait_for is not None:
             device_fence(wait_for)
-        self.elapsed_ += time.perf_counter() - self._t0
+        t1 = time.perf_counter()
+        self.elapsed_ += t1 - self._t0
         self.count_ += 1
         self.started_ = False
+        if _SPAN_HOOK is not None:
+            _SPAN_HOOK(self.name, self._t0, t1)
 
     def reset(self) -> None:
         self.elapsed_ = 0.0

@@ -15,13 +15,19 @@ from typing import Any, Callable, Iterable
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.utils.compat import HAS_VMA
+from apex_tpu.utils.compat import axis_size as _axis_size
+
 __all__ = ["cast_to_vma", "scan_stable_vma", "invariant_all_gather",
            "reconcile_cotangent", "restore_invariant", "leaf_vma",
            "fixed_point_vma"]
 
 
 def leaf_vma(x) -> frozenset:
-    """The varying-manual-axes set of a value (empty outside shard_map)."""
+    """The varying-manual-axes set of a value (empty outside shard_map,
+    and on pre-VMA jax where there is no replication typing at all)."""
+    if not HAS_VMA:
+        return frozenset()
     return getattr(jax.typeof(x), "vma", None) or frozenset()
 
 
@@ -55,6 +61,8 @@ def reconcile_cotangent(ct: jnp.ndarray, primal: jnp.ndarray) -> jnp.ndarray:
     the cotangent lacks are pvaried (type-only, value-preserving). No-op
     when the types already agree.
     """
+    if not HAS_VMA:
+        return ct
     ct_vma = leaf_vma(ct)
     p_vma = leaf_vma(primal)
     extra = tuple(sorted(ct_vma - p_vma))
@@ -67,7 +75,10 @@ def reconcile_cotangent(ct: jnp.ndarray, primal: jnp.ndarray) -> jnp.ndarray:
 
 
 def cast_to_vma(x: jnp.ndarray, vma: frozenset) -> jnp.ndarray:
-    """Upcast ``x`` to be device-varying over at least ``vma`` (idempotent)."""
+    """Upcast ``x`` to be device-varying over at least ``vma`` (idempotent;
+    a no-op on pre-VMA jax, whose shard_map has no replication types)."""
+    if not HAS_VMA:
+        return x
     cur = getattr(jax.typeof(x), "vma", frozenset())
     missing = tuple(a for a in vma if a not in cur)
     if missing:
@@ -129,7 +140,7 @@ def invariant_all_gather(x: jnp.ndarray, axis_name: str, axis: int = 0
     try:
         from jax._src.lax.parallel import all_gather_invariant
     except ImportError:  # pragma: no cover - private symbol moved
-        size = jax.lax.axis_size(axis_name)
+        size = _axis_size(axis_name)
         rank = jax.lax.axis_index(axis_name)
         full = list(x.shape)
         full[axis] *= size

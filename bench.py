@@ -39,6 +39,7 @@ Other configs:
 """
 
 import json
+import os
 import sys
 import time
 
@@ -48,8 +49,12 @@ import numpy as np
 
 # persistent compile cache: the bench programs are identical across runs,
 # so a warm cache turns the ~10 min cold-compile wall into seconds and
-# keeps the headline (printed last) inside any driver timeout
-jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+# keeps the headline (printed last) inside any driver timeout. An
+# operator-set JAX_COMPILATION_CACHE_DIR wins over the default (the
+# dryrun wrapper in __graft_entry__ already respects it; ADVICE.md r5).
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                 "/tmp/jaxcache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
 
 A100_AMP_RN50_IMGS_PER_SEC = 2470.0  # per-chip baseline (see docstring)
@@ -243,7 +248,13 @@ def bench_layernorm():
     for rows, hidden in [(8192, 4096), (1024, 32768)]:
         auto_ms, auto_std = measure(rows, hidden, None)
         pallas_ms, _ = measure(rows, hidden, True)
-        _emit("layernorm_fwd_bwd_ms", auto_ms, "ms", pallas_ms / auto_ms,
+        # metric renamed from layernorm_fwd_bwd_ms (r5): the old name's
+        # vs_baseline flipped meaning mid-history (xla_ms/pallas_ms on the
+        # Pallas time -> pallas_ms/auto_ms on the auto time); the new name
+        # pins the auto-path semantics so cross-round consumers can't
+        # silently compare inverted ratios (ADVICE.md r5, BASELINE.md)
+        _emit("layernorm_auto_fwd_bwd_ms", auto_ms, "ms",
+              pallas_ms / auto_ms,
               rows=rows, hidden=hidden, selected_path="xla",
               pallas_ms=round(pallas_ms, 3), std_ms=round(auto_std, 3))
 

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Static check: the documented hot-path ``jax.named_scope`` annotations
+still exist in source.
+
+The annotate -> trace -> attribute workflow (``utils/timers.py`` module
+docstring, ``docs/OBSERVABILITY.md``) depends on four names showing up in
+HLO op metadata so captured profiles stay attributable; a refactor that
+drops one silently rots the trace-viewer contract. This script greps the
+exact ``named_scope("<name>")`` strings out of the owning sources — no jax
+import, so it runs anywhere, pre-commit fast — and exits non-zero listing
+anything missing. Wired into the test suite via
+``tests/test_observability.py::test_check_annotations_script``.
+
+Usage::
+
+    python scripts/check_annotations.py          # check, report, exit 0/1
+    python scripts/check_annotations.py --list   # print the contract
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# annotation -> source files allowed to carry it (repo-relative). The
+# contract is "exists in at least one of its owning files": moving an
+# annotation to an unrelated module is a docs-breaking change and should
+# fail here until the table (and docs) are updated.
+ANNOTATIONS = {
+    "apex_ddp_allreduce": ["apex_tpu/parallel/distributed.py"],
+    "sync_bn_stats": ["apex_tpu/parallel/sync_batchnorm.py"],
+    "pipeline_tick": [
+        "apex_tpu/transformer/pipeline_parallel/schedules.py"],
+    "flash_attention": ["apex_tpu/ops/flash_attention.py"],
+}
+
+
+def check(repo: str = REPO):
+    """Returns (ok, report_lines)."""
+    lines = []
+    ok = True
+    for name, files in sorted(ANNOTATIONS.items()):
+        needle = f'named_scope("{name}")'
+        found_in = []
+        for rel in files:
+            path = os.path.join(repo, rel)
+            try:
+                with open(path) as f:
+                    if needle in f.read():
+                        found_in.append(rel)
+            except OSError:
+                pass
+        if found_in:
+            lines.append(f"ok       {name}: {', '.join(found_in)}")
+        else:
+            ok = False
+            lines.append(f"MISSING  {name}: expected "
+                         f'{needle} in {" or ".join(files)}')
+    return ok, lines
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--list" in argv:
+        for name, files in sorted(ANNOTATIONS.items()):
+            print(f"{name}\t{','.join(files)}")
+        return 0
+    ok, lines = check()
+    for line in lines:
+        print(line)
+    if not ok:
+        print("hot-path trace annotations missing — update the source or "
+              "the contract table in scripts/check_annotations.py + "
+              "docs/OBSERVABILITY.md", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

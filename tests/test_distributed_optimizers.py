@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from apex_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu.amp.scaler import all_finite
@@ -162,6 +162,12 @@ def test_zero_step_compiles_to_three_collectives(mesh):
     params — no hidden extra all-reduces. Counted in the compiled HLO
     (overlap itself is XLA's latency-hiding scheduler; the countable
     invariant is that there is nothing else to overlap-hide)."""
+    try:
+        from jax._src.lax.parallel import all_gather_invariant  # noqa: F401
+    except ImportError:
+        pytest.skip("this jax lacks all_gather_invariant; the param "
+                    "gather lowers via the documented psum fallback, so "
+                    "the 3-collective pattern doesn't apply")
     opt = DistributedFusedAdam(lr=1e-2)
     params = _params()
     grads = jax.tree_util.tree_map(jnp.ones_like, params)

@@ -55,3 +55,15 @@ def test_long_context_example_runs():
     import long_context
     val = long_context.main(["--seq-per-device", "64"])
     assert np.isfinite(val)
+
+
+def test_telemetry_example_runs(tmp_path):
+    """The observability worked example: 3 steps must stream the full
+    documented metric surface and a loadable Chrome trace."""
+    import telemetry
+    payload = telemetry.main(["--steps", "3", "--out-dir", str(tmp_path)])
+    for key in ("loss", "amp/loss_scale", "ddp/allreduce_bytes",
+                "optim/grad_norm", "pipeline/bubble_fraction"):
+        assert key in payload
+    assert (tmp_path / "telemetry.jsonl").exists()
+    assert (tmp_path / "host_trace.json").exists()

@@ -1,0 +1,102 @@
+"""Rank-aware logging tests (``apex_tpu/utils/logging.py`` —
+``reference:apex/transformer/log_util.py:5-20`` and amp's ``maybe_print``
+rank gating, ``reference:apex/amp/_amp_state.py:39-51``)."""
+
+import io
+import logging
+
+import pytest
+
+from apex_tpu.utils import logging as apex_logging
+
+
+@pytest.fixture()
+def fresh_logger(monkeypatch):
+    """An isolated apex_tpu logger: reset the module's configured flag and
+    strip handlers so each test installs its own stream."""
+    logger = logging.getLogger(apex_logging._ROOT_NAME)
+    old_handlers = list(logger.handlers)
+    old_level = logger.level
+    for h in old_handlers:
+        logger.removeHandler(h)
+    monkeypatch.setattr(apex_logging, "_configured", False)
+    yield logger
+    for h in list(logger.handlers):
+        logger.removeHandler(h)
+    for h in old_handlers:
+        logger.addHandler(h)
+    logger.setLevel(old_level)
+
+
+def test_rank_info_formatter_prefixes_records(fresh_logger):
+    stream = io.StringIO()
+    apex_logging.setup_logging(stream=stream)
+    apex_logging.get_logger("unit").info("hello")
+    out = stream.getvalue()
+    assert "hello" in out
+    assert "apex_tpu.unit" in out
+    # single-process test rig: the fallback (proc N) prefix
+    assert "(proc 0)" in out
+
+
+def test_rank_info_formatter_standalone():
+    fmt = apex_logging.RankInfoFormatter("%(rank_info)s %(message)s")
+    rec = logging.LogRecord("apex_tpu", logging.INFO, __file__, 1,
+                            "msg", (), None)
+    line = fmt.format(rec)
+    assert line.endswith("msg")
+    assert line.startswith("(")  # either (proc N) or the rank tuple
+
+
+def test_setup_logging_idempotent_and_level_preserving(fresh_logger):
+    stream = io.StringIO()
+    logger = apex_logging.setup_logging(stream=stream,
+                                        level=logging.WARNING)
+    n_handlers = len(logger.handlers)
+    # implicit re-setup (what get_logger does) must not stack handlers or
+    # reset the chosen level
+    apex_logging.setup_logging()
+    assert len(logger.handlers) == n_handlers
+    assert logger.level == logging.WARNING
+
+
+def test_set_verbosity(fresh_logger):
+    stream = io.StringIO()
+    apex_logging.setup_logging(stream=stream)
+    log = apex_logging.get_logger("v")
+    apex_logging.set_verbosity(logging.ERROR)
+    log.info("quiet")
+    assert "quiet" not in stream.getvalue()
+    apex_logging.set_verbosity(logging.DEBUG)
+    log.debug("loud")
+    assert "loud" in stream.getvalue()
+
+
+def test_rank_zero_only_runs_on_rank0(monkeypatch):
+    calls = []
+
+    @apex_logging.rank_zero_only
+    def fn(x):
+        calls.append(x)
+        return x * 2
+
+    monkeypatch.setattr(apex_logging, "_process_index", lambda: 0)
+    assert fn(3) == 6
+    monkeypatch.setattr(apex_logging, "_process_index", lambda: 1)
+    assert fn(4) is None
+    assert calls == [3]
+
+
+def test_process_index_env_fallback(monkeypatch):
+    """Without a working jax import path the env var decides the rank."""
+    import builtins
+    real_import = builtins.__import__
+
+    def no_jax(name, *a, **k):
+        if name == "jax":
+            raise ImportError("jax disabled for test")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", no_jax)
+    monkeypatch.setenv("JAX_PROCESS_INDEX", "3")
+    assert apex_logging._process_index() == 3

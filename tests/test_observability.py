@@ -1,0 +1,640 @@
+"""Telemetry subsystem tests: registry, in-graph accumulators (mesh
+aggregation under shard_map), sinks, StepReporter, runtime introspection,
+and the amp/DDP/pipeline/optimizer hot-path instrumentation — including
+the zero-cost-when-inactive contract asserted on the traced program."""
+
+import io
+import json
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import observability as obs
+from apex_tpu.observability import ingraph
+from apex_tpu.utils.compat import shard_map
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_counter_gauge_histogram(self):
+        r = obs.MetricsRegistry()
+        r.counter("c").inc()
+        r.counter("c").inc(2.5)
+        r.gauge("g").set(7)
+        h = r.histogram("h", buckets=[1.0, 10.0])
+        for v in (0.5, 5.0, 50.0, 0.2):
+            h.observe(v)
+        snap = r.snapshot()
+        assert snap["c"] == 3.5
+        assert snap["g"] == 7.0
+        assert snap["h_count"] == 4.0
+        assert snap["h_sum"] == pytest.approx(55.7)
+        # Prometheus le contract: cumulative counts, le_inf == count
+        assert snap["h_bucket_le_1"] == 2.0
+        assert snap["h_bucket_le_10"] == 3.0
+        assert snap["h_bucket_le_inf"] == 4.0
+
+    def test_get_or_create_and_kind_conflict(self):
+        r = obs.MetricsRegistry()
+        assert r.counter("x") is r.counter("x")
+        with pytest.raises(TypeError):
+            r.gauge("x")
+
+    def test_unset_gauge_skipped_and_reset(self):
+        r = obs.MetricsRegistry()
+        r.gauge("never_set")
+        r.counter("c").inc(5)
+        assert "never_set" not in r.snapshot()
+        r.reset()
+        assert r.snapshot()["c"] == 0.0
+
+    def test_default_registry_singleton(self):
+        assert obs.get_registry() is obs.get_registry()
+
+
+# ---------------------------------------------------------------------------
+# in-graph accumulators
+# ---------------------------------------------------------------------------
+
+class TestInGraph:
+    def test_record_is_noop_without_collector(self):
+        evaluated = []
+        ingraph.record("m", lambda: evaluated.append(1) or 1.0)
+        assert not evaluated and not ingraph.recording()
+
+    def test_reap_returns_metrics(self):
+        def fn(x):
+            ingraph.record("a", x.sum(), reduce="sum")
+            ingraph.record("b", lambda: x.max(), reduce="max")
+            return x * 2
+
+        out, metrics = jax.jit(ingraph.reap(fn))(jnp.arange(4.0))
+        assert np.allclose(out, [0, 2, 4, 6])
+        got = metrics.as_floats()
+        assert got == {"a": 6.0, "b": 3.0}
+        assert metrics.modes["a"] == "sum"
+
+    def test_sum_rerecord_accumulates_others_overwrite(self):
+        def fn():
+            ingraph.record("s", 1.0, reduce="sum")
+            ingraph.record("s", 2.0, reduce="sum")
+            ingraph.record("g", 1.0, reduce="mean")
+            ingraph.record("g", 5.0, reduce="mean")
+            return jnp.zeros(())
+
+        _, m = ingraph.reap(fn)()
+        assert m.as_floats() == {"s": 3.0, "g": 5.0}
+
+    def test_mode_conflict_and_bad_inputs(self):
+        with ingraph.collecting():
+            ingraph.record("m", 1.0, reduce="sum")
+            with pytest.raises(ValueError):
+                ingraph.record("m", 1.0, reduce="mean")
+            with pytest.raises(ValueError):
+                ingraph.record("vec", jnp.ones(3))
+            with pytest.raises(ValueError):
+                ingraph.record("m2", 1.0, reduce="median")
+
+    def test_metrics_is_a_pytree(self):
+        m = ingraph.Metrics({"a": jnp.asarray(1.0)}, {"a": "sum"})
+        leaves, treedef = jax.tree_util.tree_flatten(m)
+        assert len(leaves) == 1
+        m2 = jax.tree_util.tree_unflatten(treedef, leaves)
+        assert m2.modes == {"a": "sum"} and "a" in m2
+
+    def test_mesh_aggregation_under_shard_map(self):
+        mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+
+        def body(x):
+            rank = jax.lax.axis_index("data").astype(jnp.float32)
+            ingraph.record("r/sum", rank, reduce="sum")
+            ingraph.record("r/mean", rank, reduce="mean")
+            ingraph.record("r/max", rank, reduce="max")
+            ingraph.record("r/min", rank, reduce="min")
+            return x
+
+        def inner(x):
+            out, metrics = ingraph.reap(body)(x)
+            return out, ingraph.aggregate(metrics, "data")
+
+        _, metrics = jax.jit(lambda x: shard_map(
+            inner, mesh=mesh, in_specs=P("data"),
+            out_specs=(P("data"), P()))(x))(jnp.arange(8.0))
+        got = metrics.as_floats()
+        assert got == {"r/sum": 6.0, "r/mean": 1.5, "r/max": 3.0,
+                       "r/min": 0.0}
+
+    def test_aggregate_identity_without_axes(self):
+        _, m = ingraph.reap(lambda: ingraph.record("a", 2.0) or jnp.zeros(()))()
+        assert ingraph.aggregate(m, None).as_floats() == {"a": 2.0}
+
+
+# ---------------------------------------------------------------------------
+# zero-cost-when-inactive contract (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+class TestZeroCost:
+    def _instrumented_step(self):
+        from apex_tpu.amp.scaler import DynamicLossScale, all_finite
+        from apex_tpu.optimizers import FusedSGD
+
+        scaler = DynamicLossScale()
+        opt = FusedSGD(lr=0.1)
+
+        def step(params, opt_state, ls, x):
+            grads = jax.grad(lambda p: jnp.sum((x @ p) ** 2))(params)
+            finite = all_finite(grads)
+            new_ls = scaler.update(ls, finite)
+            params, opt_state = opt.step(grads, opt_state, params,
+                                         grads_finite=finite)
+            return params, opt_state, new_ls
+
+        params = jnp.ones((4, 2))
+        opt = FusedSGD(lr=0.1)
+        return step, (params, opt.init(params), scaler.init(),
+                      jnp.ones((3, 4)))
+
+    def test_no_collector_no_collectives_no_extra_outputs(self):
+        """With no collector the instrumented amp+optimizer step must add
+        no device collectives, no telemetry math (the grad-norm sqrt), and
+        no extra outputs — i.e. no per-step host transfers beyond the
+        step's own results."""
+        step, args = self._instrumented_step()
+        jaxpr = jax.make_jaxpr(step)(*args)
+        txt = str(jaxpr)
+        for collective in ("psum", "pmean", "pmax", "pmin", "all_reduce"):
+            assert collective not in txt
+        assert "sqrt" not in txt  # optim/grad_norm's reduction is absent
+        n_plain_outputs = len(jax.tree_util.tree_leaves(
+            jax.eval_shape(step, *args)))
+
+        reaped = ingraph.reap(step)
+        jaxpr_on = jax.make_jaxpr(reaped)(*args)
+        assert "sqrt" in str(jaxpr_on)  # grad norm present when collecting
+        n_on_outputs = len(jax.tree_util.tree_leaves(
+            jax.eval_shape(reaped, *args)))
+        assert n_on_outputs > n_plain_outputs
+
+    def test_ddp_allreduce_hlo_unchanged_without_collector(self):
+        """The instrumented DDP sync compiles to the same collective count
+        as ever when telemetry is off (its metrics are trace-time
+        constants, so even with it on, only aggregation adds psums)."""
+        from apex_tpu.parallel.distributed import allreduce_grads
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+
+        def step(g):
+            return shard_map(
+                lambda g: allreduce_grads({"w": g, "b": g[0]}, "data"),
+                mesh=mesh, in_specs=P("data"),
+                out_specs={"w": P("data"), "b": P("data")})(g)
+
+        txt = jax.jit(step).lower(jnp.ones((2, 4))).as_text()
+        # one collective per grad leaf, no more (spelling differs between
+        # StableHLO and HLO renderings across jax versions)
+        assert txt.count("all-reduce") + txt.count("all_reduce") == 2
+
+
+# ---------------------------------------------------------------------------
+# sinks
+# ---------------------------------------------------------------------------
+
+class TestSinks:
+    def test_jsonl_shape(self):
+        buf = io.StringIO()
+        sink = obs.JSONLSink(buf)
+        sink.emit(3, {"b": 2.0, "a": 1.0})
+        line = json.loads(buf.getvalue())
+        assert line["step"] == 3
+        assert isinstance(line["time"], float)
+        assert line["metrics"] == {"a": 1.0, "b": 2.0}
+        assert list(line["metrics"]) == ["a", "b"]  # sorted, grep-stable
+
+    def test_jsonl_appends_to_path(self, tmp_path):
+        p = tmp_path / "events.jsonl"
+        with obs.JSONLSink(p) as sink:
+            sink.emit(0, {"x": 1.0})
+            sink.emit(1, {"x": 2.0})
+        lines = [json.loads(l) for l in p.read_text().splitlines()]
+        assert [l["step"] for l in lines] == [0, 1]
+
+    def test_tensorboard_protocol(self):
+        calls = []
+
+        class Writer:
+            def add_scalar(self, tag, value, step):
+                calls.append((tag, value, step))
+
+        obs.TensorBoardSink(Writer()).emit(7, {"b": 2.0, "a": 1.0})
+        assert calls == [("a", 1.0, 7), ("b", 2.0, 7)]
+        with pytest.raises(TypeError):
+            obs.TensorBoardSink(object())
+
+    def test_chrome_trace_spans_and_counters(self, tmp_path):
+        p = tmp_path / "trace.json"
+        sink = obs.ChromeTraceSink(p, pid=5)
+        spans = [obs.Span("fwd", 1.0, 1.5), obs.Span("opt", 1.5, 1.6)]
+        sink.emit(2, {"loss": 0.5}, spans)
+        sink.close()
+        doc = json.loads(p.read_text())
+        events = doc["traceEvents"]
+        complete = [e for e in events if e["ph"] == "X"]
+        assert [e["name"] for e in complete] == ["fwd", "opt"]
+        assert complete[0]["dur"] == pytest.approx(0.5e6)
+        assert complete[0]["pid"] == 5
+        assert complete[0]["args"]["step"] == 2
+        counters = [e for e in events if e["ph"] == "C"]
+        assert counters and counters[0]["args"] == {"loss": 0.5}
+
+
+# ---------------------------------------------------------------------------
+# StepReporter + timer spans
+# ---------------------------------------------------------------------------
+
+class TestStepReporter:
+    def test_merges_ingraph_registry_timers_extra(self):
+        from apex_tpu.utils.timers import Timers
+
+        reg = obs.MetricsRegistry()
+        reg.counter("host/c").inc(4)
+        timers = Timers()
+        timers("fwd").start()
+        time.sleep(0.002)
+        timers("fwd").stop()
+        buf = io.StringIO()
+        rep = obs.StepReporter([obs.JSONLSink(buf)], registry=reg,
+                               timers=timers)
+        _, metrics = ingraph.reap(
+            lambda: ingraph.record("m", 1.5) or jnp.zeros(()))()
+        payload = rep.report(0, metrics=metrics, extra={"loss": 2.0})
+        assert payload["m"] == 1.5
+        assert payload["host/c"] == 4.0
+        assert payload["loss"] == 2.0
+        assert payload["time/fwd_ms"] >= 2.0
+        assert json.loads(buf.getvalue())["metrics"]["m"] == 1.5
+        # reset_timers=True drained the timer
+        assert timers("fwd").elapsed(reset=False) == 0.0
+
+    def test_interval_gating(self):
+        emitted = []
+
+        class Spy(obs.JSONLSink):
+            def __init__(self):
+                pass
+
+            def emit(self, step, metrics, spans=()):
+                emitted.append(step)
+
+            def close(self):
+                pass
+
+        rep = obs.StepReporter([Spy()], registry=obs.MetricsRegistry(),
+                               interval=3)
+        for s in range(7):
+            rep.report(s)
+        assert emitted == [0, 3, 6]
+
+    def test_timer_spans_reach_chrome_sink(self, tmp_path):
+        from apex_tpu.utils.timers import Timers
+
+        p = tmp_path / "t.json"
+        timers = Timers()
+        with obs.StepReporter([obs.ChromeTraceSink(p)],
+                              registry=obs.MetricsRegistry(),
+                              timers=timers, capture_spans=True) as rep:
+            with timers("step")():
+                time.sleep(0.001)
+            rep.report(0)
+        assert not obs.spans_enabled()  # close() restored the default
+        events = json.loads(p.read_text())["traceEvents"]
+        spans = [e for e in events if e["ph"] == "X"]
+        assert [e["name"] for e in spans] == ["step"]
+
+    def test_null_reporter_default(self):
+        obs.detach_reporter()
+        rep = obs.get_reporter()
+        assert not rep
+        assert rep.report(0, extra={"x": 1}) is None
+        real = obs.attach_reporter(
+            obs.StepReporter([], registry=obs.MetricsRegistry()))
+        try:
+            assert obs.get_reporter() is real
+        finally:
+            obs.detach_reporter()
+        assert not obs.get_reporter()
+
+
+# ---------------------------------------------------------------------------
+# runtime introspection
+# ---------------------------------------------------------------------------
+
+class TestRuntime:
+    def test_compile_listener_counts_fresh_compile(self):
+        reg = obs.MetricsRegistry()
+        assert obs.install_compile_listeners(reg) is reg
+        obs.install_compile_listeners(reg)  # idempotent: no double count
+        before = reg.counter("jax/compiles").value
+        salt = np.random.default_rng().integers(1 << 30)
+        jax.jit(lambda x: x * float(salt))(jnp.ones(3)).block_until_ready()
+        after = reg.counter("jax/compiles").value
+        assert after == before + 1
+        assert reg.counter("jax/traces").value >= after
+        snap = reg.snapshot()
+        assert snap["jax/compile_seconds_count"] == after
+
+    def test_memory_stats_sampler(self):
+        reg = obs.MetricsRegistry()
+        out = obs.sample_memory_stats(reg)
+        # CPU backends expose no allocator stats; on TPU/GPU each device
+        # contributes bytes_in_use
+        for name, value in out.items():
+            assert name.startswith("memory/")
+            assert value >= 0
+            assert reg.snapshot()[name] == value
+
+
+# ---------------------------------------------------------------------------
+# hot-path instrumentation
+# ---------------------------------------------------------------------------
+
+class TestHotPaths:
+    def test_amp_scaler_metrics_on_overflow(self):
+        from apex_tpu.amp.scaler import DynamicLossScale
+
+        scaler = DynamicLossScale(init_scale=16.0)
+
+        def update(ls, finite):
+            return scaler.update(ls, finite)
+
+        reaped = jax.jit(ingraph.reap(update))
+        _, m = reaped(scaler.init(), jnp.asarray(False))
+        got = m.as_floats()
+        assert got["amp/loss_scale"] == 8.0  # halved on overflow
+        assert got["amp/overflow_count"] == 1.0
+        assert got["amp/skipped_steps"] == 1.0
+        _, m = reaped(scaler.init(), jnp.asarray(True))
+        got = m.as_floats()
+        assert got["amp/loss_scale"] == 16.0
+        assert got["amp/overflow_count"] == 0.0
+
+    def test_static_scaler_also_reports(self):
+        from apex_tpu.amp.scaler import StaticLossScale
+
+        scaler = StaticLossScale(scale=4.0)
+        _, m = ingraph.reap(scaler.update)(scaler.init(),
+                                           jnp.asarray(False))
+        got = m.as_floats()
+        assert got["amp/loss_scale"] == 4.0
+        assert got["amp/skipped_steps"] == 1.0
+
+    def test_ddp_allreduce_bytes_mesh_aggregated(self):
+        from apex_tpu.parallel.distributed import allreduce_grads
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+        grads = {"w": jnp.ones((2, 8, 4)), "b": jnp.ones((2, 4))}
+        per_rank = 8 * 4 * 4 + 4 * 4  # f32 leaf bytes on one rank
+
+        def inner(g):
+            out, m = ingraph.reap(
+                lambda g: allreduce_grads(g, "data"))(g)
+            return out, ingraph.aggregate(m, "data")
+
+        _, m = jax.jit(lambda g: shard_map(
+            inner, mesh=mesh,
+            in_specs=({"w": P("data"), "b": P("data")},),
+            out_specs=({"w": P("data"), "b": P("data")}, P()))(g))(grads)
+        got = m.as_floats()
+        assert got["ddp/allreduce_bytes"] == 2 * per_rank  # psum over mesh
+        assert got["ddp/buckets"] == 2.0
+
+    def test_ddp_fp32_upcast_counts_fp32_bytes(self):
+        from apex_tpu.parallel.distributed import allreduce_grads
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+        g16 = jnp.ones((2, 8), jnp.bfloat16)
+
+        def inner(g):
+            out, m = ingraph.reap(lambda g: allreduce_grads(
+                g, "data", allreduce_always_fp32=True))(g)
+            return out, ingraph.aggregate(m, "data")
+
+        _, m = jax.jit(lambda g: shard_map(
+            inner, mesh=mesh, in_specs=P("data"),
+            out_specs=(P("data"), P()))(g))(g16)
+        assert m.as_floats()["ddp/allreduce_bytes"] == 2 * 8 * 4
+
+    def test_optimizer_grad_norm(self):
+        from apex_tpu.optimizers import FusedSGD
+
+        opt = FusedSGD(lr=0.0)  # lr 0: params unchanged, norm still real
+        params = {"a": jnp.ones(3), "b": jnp.zeros(2)}
+        grads = {"a": jnp.full(3, 2.0), "b": jnp.zeros(2)}
+
+        def step(g, s, p):
+            return opt.step(g, s, p)
+
+        _, m = jax.jit(ingraph.reap(step))(grads, opt.init(params), params)
+        assert m.as_floats()["optim/grad_norm"] == pytest.approx(
+            float(np.sqrt(12.0)))
+
+    def test_pipeline_no_pipelining_reports_zero_bubble(self):
+        from apex_tpu.transformer.pipeline_parallel.schedules import (
+            forward_backward_no_pipelining)
+
+        batch = jnp.ones((4, 2, 3))
+        params = {"w": jnp.ones((3,))}
+
+        def fwd(p, mb):
+            return jnp.mean(mb * p["w"])
+
+        def run(params):
+            return forward_backward_no_pipelining(fwd, batch, params)
+
+        _, m = jax.jit(ingraph.reap(run))(params)
+        got = m.as_floats()
+        assert got["pipeline/bubble_fraction"] == 0.0
+        assert got["pipeline/num_microbatches"] == 4.0
+        assert got["pipeline/ticks"] == 4.0
+
+    def test_pipeline_1f1b_bubble_fraction(self):
+        from apex_tpu.transformer.pipeline_parallel.schedules import (
+            forward_backward_pipelining_without_interleaving)
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("pipe",))
+        pp, M, D = 2, 4, 4
+        ws = jnp.ones((pp, D, D)) * 0.1
+        micro = jnp.ones((M, 2, D))
+
+        def stage(p, x, s):
+            return jnp.tanh(x @ p["w"])
+
+        def inner(ws):
+            def body(ws):
+                return forward_backward_pipelining_without_interleaving(
+                    stage, micro, {"w": ws[0]},
+                    loss_fn=lambda y, m: jnp.mean(y ** 2))
+            out, m = ingraph.reap(body)(ws)
+            return out, ingraph.aggregate(m, "pipe")
+
+        (_, _), m = jax.jit(lambda w: shard_map(
+            inner, mesh=mesh, in_specs=(P("pipe"),),
+            out_specs=((P(), {"w": P("pipe")}), P()))(w))(ws)
+        got = m.as_floats()
+        # fwd+bwd 1F1B scan: T = M + 2L - 1 = 7 ticks, M useful -> 3/7
+        assert got["pipeline/ticks"] == 7.0
+        assert got["pipeline/bubble_fraction"] == pytest.approx(3.0 / 7.0)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance toy run: 3 steps, full stream, mesh-aggregated
+# ---------------------------------------------------------------------------
+
+def test_three_step_toy_run_emits_full_stream(tmp_path):
+    """amp + DDP + pipelined schedule + fused optimizer on a pipe x data
+    CPU mesh for 3 steps: the JSONL stream must carry the whole documented
+    metric surface with per-rank values psum-aggregated across the mesh."""
+    from apex_tpu.amp.scaler import DynamicLossScale, all_finite
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.optimizers.fused_sgd import SGDState
+    from apex_tpu.parallel.distributed import allreduce_grads
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        forward_backward_pipelining_without_interleaving)
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                ("pipe", "data"))
+    pp, M, mb, D = 2, 4, 2, 8
+    rng = np.random.RandomState(0)
+    ws = jnp.asarray(rng.randn(pp, D, D) * 0.3, jnp.float32)
+    micro = jnp.asarray(rng.randn(M, 2 * mb, D), jnp.float32)
+    scaler = DynamicLossScale(init_scale=2.0 ** 4, growth_interval=2)
+    opt = FusedSGD(lr=1e-2, momentum=0.9)
+    opt_state = opt.init(ws)
+    ls = scaler.init()
+
+    def stage(p, x, s):
+        return jnp.tanh(x @ p["w"])
+
+    def body(ws, opt_state, ls, micro):
+        loss, grads = forward_backward_pipelining_without_interleaving(
+            stage, micro, {"w": ws[0]},
+            loss_fn=lambda y, m: jnp.mean(y ** 2),
+            grad_scale=ls.loss_scale)
+        grads = allreduce_grads(grads["w"][None], "data")
+        finite = all_finite(grads, axis_names=("pipe",))
+        new_ls = scaler.update(ls, finite)
+        new_w, new_s = opt.step(grads, opt_state, ws, grads_finite=finite)
+        return jax.lax.pmean(loss, "data"), new_w, new_s, new_ls
+
+    def inner(*args):
+        out, metrics = ingraph.reap(body)(*args)
+        return out + (ingraph.aggregate(metrics, ("pipe", "data")),)
+
+    ospec = SGDState(step=P(), momentum_buf=P("pipe"))
+    step = jax.jit(lambda w, s, l, m: shard_map(
+        inner, mesh=mesh,
+        in_specs=(P("pipe"), ospec, P(), P(None, "data")),
+        out_specs=(P(), P("pipe"), ospec, P(), P()))(w, s, l, m))
+
+    path = tmp_path / "telemetry.jsonl"
+    with obs.StepReporter([obs.JSONLSink(path)],
+                          registry=obs.MetricsRegistry()) as rep:
+        for i in range(3):
+            loss, ws, opt_state, ls, metrics = step(ws, opt_state, ls,
+                                                    micro)
+            rep.report(i, metrics=metrics, extra={"loss": float(loss)})
+
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [l["step"] for l in lines] == [0, 1, 2]
+    for line in lines:
+        m = line["metrics"]
+        for key in ("amp/loss_scale", "amp/overflow_count",
+                    "amp/skipped_steps", "ddp/allreduce_bytes",
+                    "ddp/buckets", "optim/grad_norm",
+                    "pipeline/bubble_fraction", "pipeline/ticks",
+                    "pipeline/num_microbatches", "loss"):
+            assert key in m, key
+    last = lines[-1]["metrics"]
+    # psum-aggregation across the 4-device mesh: each rank contributes its
+    # (1, D, D) f32 grad leaf per sync
+    assert last["ddp/allreduce_bytes"] == 4 * D * D * 4
+    # growth_interval=2, 3 clean steps -> one doubling of 2**4
+    assert last["amp/loss_scale"] == 32.0
+    assert last["pipeline/bubble_fraction"] == pytest.approx(3.0 / 7.0)
+    assert last["optim/grad_norm"] > 0.0
+
+
+def test_hybrid_trainer_step_with_metrics():
+    """GPTHybridTrainer.train_step_with_metrics must produce the same loss
+    as train_step plus the full mesh-aggregated telemetry surface."""
+    from apex_tpu.config import (BatchConfig, ModelConfig, OptimizerConfig,
+                                 ParallelConfig, TrainConfig)
+    from apex_tpu.training import GPTHybridTrainer
+    from apex_tpu.transformer import parallel_state
+
+    tp, pp, dp = 2, 2, 2
+    M, mb, seq = 4, 2, 8
+    cfg = TrainConfig(
+        model=ModelConfig(name="gpt", vocab_size=64, hidden_size=32,
+                          num_layers=2 * pp, num_attention_heads=4,
+                          max_position_embeddings=seq),
+        parallel=ParallelConfig(tensor_model_parallel_size=tp,
+                                pipeline_model_parallel_size=pp),
+        batch=BatchConfig(global_batch_size=M * mb * dp,
+                          micro_batch_size=mb),
+        optimizer=OptimizerConfig(name="adam", lr=1e-2, weight_decay=0.0),
+        opt_level="O0")
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 64, (M, dp * mb, seq)))
+    targets = jnp.asarray(rng.randint(0, 64, (M, dp * mb, seq)))
+    mesh = cfg.initialize_mesh(devices=jax.devices())
+    try:
+        trainer = GPTHybridTrainer(cfg, mesh)
+        state = trainer.init_state(jax.random.PRNGKey(0))
+        loss, *_ = jax.jit(trainer.train_step)(*state, tokens, targets)
+        loss_m, _, _, _, _, metrics = jax.jit(
+            trainer.train_step_with_metrics)(*state, tokens, targets)
+    finally:
+        parallel_state.destroy_model_parallel()
+    assert float(loss) == pytest.approx(float(loss_m), abs=1e-6)
+    got = metrics.as_floats()
+    for key in ("amp/loss_scale", "amp/overflow_count", "amp/skipped_steps",
+                "ddp/allreduce_bytes", "ddp/buckets", "optim/grad_norm",
+                "pipeline/bubble_fraction", "pipeline/ticks"):
+        assert key in got, key
+    assert got["ddp/allreduce_bytes"] > 0
+    # 1F1B over pp=2, M=4: T = 7 ticks, bubble 3/7
+    assert got["pipeline/bubble_fraction"] == pytest.approx(3.0 / 7.0)
+
+
+# ---------------------------------------------------------------------------
+# annotation contract
+# ---------------------------------------------------------------------------
+
+class TestCheckAnnotations:
+    def test_script_passes_on_this_tree(self):
+        proc = subprocess.run(
+            [sys.executable, "scripts/check_annotations.py"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert proc.stdout.count("ok ") == 4
+
+    def test_detects_missing_annotation(self, tmp_path):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "check_annotations", "scripts/check_annotations.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        ok, lines = mod.check(repo=str(tmp_path))  # empty tree: all missing
+        assert not ok
+        assert sum("MISSING" in l for l in lines) == len(mod.ANNOTATIONS)
+        ok, _ = mod.check()
+        assert ok

@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from apex_tpu.utils.compat import shard_map
 
 from apex_tpu.parallel import (
     DistributedDataParallel, Reducer, SyncBatchNorm, allreduce_grads,

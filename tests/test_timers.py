@@ -67,7 +67,7 @@ def test_named_scopes_reach_hlo():
     """The pre-annotated hot paths must show up in lowered HLO metadata —
     that is what makes a captured profile attributable (the pyprof
     annotate-step equivalent)."""
-    from jax import shard_map
+    from apex_tpu.utils.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from apex_tpu.parallel.distributed import allreduce_grads
@@ -76,12 +76,20 @@ def test_named_scopes_reach_hlo():
 
     mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
 
+    def scope_text(lowered):
+        """Render with op metadata: newer jax carries scopes in the
+        lowered text under debug_info=True; 0.4.x only in compiled HLO."""
+        try:
+            return lowered.as_text(debug_info=True)
+        except TypeError:
+            return lowered.compile().as_text()
+
     def step(g):
         return shard_map(
             lambda g: allreduce_grads({"w": g}, "data")["w"],
             mesh=mesh, in_specs=P("data"), out_specs=P("data"))(g)
 
-    txt = jax.jit(step).lower(jnp.ones((2, 4))).as_text(debug_info=True)
+    txt = scope_text(jax.jit(step).lower(jnp.ones((2, 4))))
     assert "apex_ddp_allreduce" in txt
 
     state = BatchNormState(jnp.zeros(3), jnp.ones(3), jnp.asarray(0))
@@ -90,7 +98,7 @@ def test_named_scopes_reach_hlo():
         return sync_batch_norm(x, jnp.ones(3), jnp.zeros(3), state,
                                channel_axis=-1)[0]
 
-    txt = jax.jit(bn).lower(jnp.ones((4, 3))).as_text(debug_info=True)
+    txt = scope_text(jax.jit(bn).lower(jnp.ones((4, 3))))
     assert "sync_bn_stats" in txt
 
 

@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 import torch
-from jax import shard_map
+from apex_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.transformer import parallel_state
