@@ -42,6 +42,13 @@ class ModelConfig:
     attention_dropout: float = 0.0
     num_classes: int = 1000           # resnet head
     remat: bool = False
+    # Megatron-LM sequence parallelism (gpt only; needs tp > 1, pp == 1;
+    # through GPTHybridTrainer additionally needs VMA jax — the trainer
+    # refuses on the pre-VMA 0.4.x line, see training.py)
+    sequence_parallel: bool = False
+    # ring-decomposed SP collectives overlapping their GEMMs (gpt only;
+    # needs sequence_parallel — see tensor_parallel.collective_matmul)
+    tp_comm_overlap: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -138,7 +145,9 @@ class TrainConfig:
                 params_dtype=pol.param_dtype,
                 compute_dtype=pol.compute_dtype,
                 hidden_dropout=m.hidden_dropout,
-                attention_dropout=m.attention_dropout, remat=m.remat))
+                attention_dropout=m.attention_dropout, remat=m.remat,
+                sequence_parallel=m.sequence_parallel,
+                tp_comm_overlap=m.tp_comm_overlap))
         if m.name == "bert":
             from apex_tpu.models import BertConfig, BertModel
             return BertModel(BertConfig(

@@ -64,6 +64,10 @@ class GPTConfig:
     # (b, s/tp, h) sequence shards; ColumnParallel inputs all-gather the
     # sequence, RowParallel outputs reduce-scatter back to shards
     sequence_parallel: bool = False
+    # Ring-decompose the SP gather/reduce-scatter under their GEMMs
+    # (tensor_parallel.collective_matmul) so the dependent TP collectives
+    # overlap with compute in fwd AND bwd; requires sequence_parallel
+    tp_comm_overlap: bool = False
     # Dropout (standalone_gpt.py attention/hidden dropout; 0.0 = off so
     # eval-style calls stay deterministic without threading an rng).
     # Semantics under TP follow the reference's RNG stream layout
@@ -105,23 +109,31 @@ class GPTModel:
             params_dtype=cfg.params_dtype, world_size=tp)
         if cfg.sequence_parallel and tp <= 1:
             raise ValueError("sequence_parallel requires tp > 1")
+        if cfg.tp_comm_overlap and not cfg.sequence_parallel:
+            raise ValueError(
+                "tp_comm_overlap requires sequence_parallel=True: only the "
+                "SP gather->GEMM / GEMM->reduce-scatter pairs are dependent "
+                "collectives (plain-TP collectives already overlap)")
         sp = cfg.sequence_parallel
+        ov = cfg.tp_comm_overlap
         self.qkv = ColumnParallelLinear(
             cfg.hidden_size, 3 * cfg.hidden_size, gather_output=False,
             init_method=init, params_dtype=cfg.params_dtype, world_size=tp,
-            sequence_parallel=sp, seq_axis=1)
+            sequence_parallel=sp, seq_axis=1, tp_comm_overlap=ov)
         self.proj = RowParallelLinear(
             cfg.hidden_size, cfg.hidden_size, input_is_parallel=True,
             init_method=out_init, params_dtype=cfg.params_dtype,
-            world_size=tp, sequence_parallel=sp, seq_axis=1)
+            world_size=tp, sequence_parallel=sp, seq_axis=1,
+            tp_comm_overlap=ov)
         self.fc1 = ColumnParallelLinear(
             cfg.hidden_size, cfg.ffn, gather_output=False, init_method=init,
             params_dtype=cfg.params_dtype, world_size=tp,
-            sequence_parallel=sp, seq_axis=1)
+            sequence_parallel=sp, seq_axis=1, tp_comm_overlap=ov)
         self.fc2 = RowParallelLinear(
             cfg.ffn, cfg.hidden_size, input_is_parallel=True,
             init_method=out_init, params_dtype=cfg.params_dtype,
-            world_size=tp, sequence_parallel=sp, seq_axis=1)
+            world_size=tp, sequence_parallel=sp, seq_axis=1,
+            tp_comm_overlap=ov)
 
     # -- params -------------------------------------------------------------
 
@@ -155,6 +167,22 @@ class GPTModel:
             "layers": layers,  # leaves stacked (num_layers, ...)
             "final_ln": {"weight": jnp.ones(cfg.hidden_size, cfg.params_dtype),
                          "bias": jnp.zeros(cfg.hidden_size, cfg.params_dtype)},
+        }
+
+    def param_specs(self, params: dict):
+        """``PartitionSpec`` tree for a :meth:`init` params pytree under
+        the standard TP layout (vocab-sharded embedding, per-layer TP
+        stacks on axis 1, replicated norms/positions) — the specs every
+        ``shard_map`` over the whole model needs; keep call sites on this
+        helper instead of hand-copying the literal."""
+        from jax.sharding import PartitionSpec as P
+        return {
+            "embedding": {"word": {"weight": P("tensor")},
+                          "position": P()},
+            "final_ln": {"weight": P(), "bias": P()},
+            "layers": jax.tree_util.tree_map(
+                lambda p: P(None, "tensor") if p.ndim >= 3 else P(),
+                params["layers"]),
         }
 
     # -- blocks -------------------------------------------------------------
@@ -251,11 +279,48 @@ class GPTModel:
             h = dropout(h, cfg.hidden_dropout, key)
         return h
 
+    def tp_overlap_fwd_bytes(self, shard_shape: Tuple[int, ...]) -> int:
+        """Per-rank forward-ring ppermute bytes for ONE pass through the
+        layer stack on a ``(b, s/tp, h)`` activation shard — the
+        ``tp/collective_bytes`` accounting (a trace-time constant). The
+        backward rings move the same chunk counts with fp32 payloads
+        (dX/dY cotangents), so train-step traffic is this plus the
+        fp32-scaled mirror."""
+        cfg = self.cfg
+        tp = cfg.tensor_model_parallel_size
+        shard = 1
+        for d in shard_shape:
+            shard *= d
+        col_bytes = shard * jnp.dtype(cfg.compute_dtype).itemsize
+        row_bytes = shard * 4  # the traveling partial-sum acc is fp32
+        # two Column rings (qkv, fc1) + two Row rings (proj, fc2) per layer
+        return cfg.num_layers * (tp - 1) * (2 * col_bytes + 2 * row_bytes)
+
+    def record_tp_overlap(self, shard_shape: Tuple[int, ...],
+                          passes: int = 1) -> None:
+        """``tp/*`` telemetry for the ring-decomposed SP collectives — the
+        single recording site, called at the step-trace level (outside the
+        layer scan / custom_vjp) because a record inside the scanned rings
+        would capture one body *trace* instead of ``num_layers``
+        *executions*. ``passes``: layer-stack passes per step (microbatch
+        count under the pipelined trainer)."""
+        from apex_tpu.observability import ingraph
+        if not ingraph.recording():
+            return
+        ingraph.record("tp/overlap_chunks",
+                       float(self.cfg.tensor_model_parallel_size),
+                       reduce="mean")
+        ingraph.record("tp/collective_bytes",
+                       float(passes * self.tp_overlap_fwd_bytes(
+                           shard_shape)), reduce="sum")
+
     def transform(self, params: dict, x: jnp.ndarray,
                   dropout_rng: Optional[jax.Array] = None) -> jnp.ndarray:
         """Run the layer stack (scan) + final LN. ``dropout_rng`` enables
         train-mode dropout (None = eval/deterministic)."""
         cfg = self.cfg
+        if cfg.tp_comm_overlap:
+            self.record_tp_overlap(x.shape)
         layer_fn = self._layer
         if cfg.remat:
             layer_fn = jax.checkpoint(layer_fn)
@@ -342,13 +407,17 @@ class GPTModel:
         microbatches), matching build_model's pre/post_process split
         (``schedules/common.py:29-148``)."""
         if self.cfg.num_layers % num_stages:
-            raise ValueError("num_layers must divide num_stages")
-        if self.cfg.sequence_parallel:
+            raise ValueError(
+                f"num_layers ({self.cfg.num_layers}) must be divisible by "
+                f"num_stages ({num_stages})")
+        if self.cfg.sequence_parallel and num_stages > 1:
             raise NotImplementedError(
-                "sequence_parallel does not compose with the pipeline "
-                "decomposition yet: the embed/head closures would run on "
-                "sequence shards without the SP gathers and the shared LN "
-                "grads would skip sp_grad_sync")
+                "sequence_parallel does not compose with a real pipeline "
+                "split yet: the inter-stage activations would cross the "
+                "pipe axis as sequence shards and the shared LN grads "
+                "would skip sp_grad_sync. num_stages == 1 (the hybrid "
+                "trainer at pp=1) is supported — embed scatters and the "
+                "head gathers, mirroring transform()")
         per = self.cfg.num_layers // num_stages
 
         def stage(stage_params: dict, x: jnp.ndarray, stage_idx) -> jnp.ndarray:
@@ -401,6 +470,14 @@ class GPTModel:
         def head_loss_fn(shared: dict, y: jnp.ndarray,
                          m: jnp.ndarray) -> jnp.ndarray:
             x = self._ln(shared["final_ln"], y)
+            if self.cfg.sequence_parallel:
+                # same placement as transform(): LN on the shard, then the
+                # invariant gather so the tied head sees the full sequence
+                # (and replicated-param grad accounting matches plain TP)
+                from apex_tpu.transformer.context_parallel import (
+                    gather_from_sequence_parallel_region)
+                x = gather_from_sequence_parallel_region(
+                    x, TENSOR_AXIS, seq_axis=1, invariant=True)
             logits = self.logits({"embedding": shared["embedding"]}, x)
             tgt = jax.lax.dynamic_index_in_dim(targets, m, 0, keepdims=False)
             if self.cfg.tensor_model_parallel_size > 1:

@@ -65,7 +65,8 @@ def grouped_psum(x: jnp.ndarray, axis_name: str,
         pass
     world = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
-    gathered = jax.lax.all_gather(x, axis_name)  # (world, ...)
+    from apex_tpu.utils.vma import varying_all_gather
+    gathered = varying_all_gather(x, axis_name, tiled=False)  # (world, ...)
 
     sizes = {len(g) for g in groups}
     contiguous_equal = (
@@ -152,9 +153,18 @@ class DistributedDataParallel:
 
     The ctor keeps the reference's argument names (``distributed.py:162-175``)
     where they still mean something; bucket/stream arguments
-    (``message_size``, ``num_allreduce_streams``, ``delay_allreduce``, ...)
-    are accepted and ignored — bucketing and overlap are XLA's scheduler's
-    concern, which is the design point of this port.
+    (``message_size``, ``num_allreduce_streams``, ...) are accepted and
+    ignored — bucketing and overlap are XLA's scheduler's concern, which is
+    the design point of this port.
+
+    ``delay_allreduce=True`` is real (torch-DDP ``no_sync`` semantics, the
+    closest analog of the reference flag at ``distributed.py:162``):
+    :meth:`value_and_grad` then returns *unsynced* per-replica grads so a
+    gradient-accumulation loop can sum K microbatches locally and fire
+    :meth:`sync_gradients` once per window — see
+    :func:`apex_tpu.training.accumulate_gradients`, which packages that
+    loop (and whose jaxpr carries exactly one psum per window, asserted in
+    tests).
     """
 
     def __init__(self, axis_name: str = "data",
@@ -162,12 +172,14 @@ class DistributedDataParallel:
                  allreduce_always_fp32: bool = False,
                  gradient_average: bool = True,
                  axis_index_groups: Optional[Sequence[Sequence[int]]] = None,
+                 delay_allreduce: bool = False,
                  **_ignored_bucketing_args):
         self.axis_name = axis_name
         self.gradient_predivide_factor = gradient_predivide_factor
         self.allreduce_always_fp32 = allreduce_always_fp32
         self.gradient_average = gradient_average
         self.axis_index_groups = axis_index_groups
+        self.delay_allreduce = delay_allreduce
 
     def sync_gradients(self, grads: Any) -> Any:
         return allreduce_grads(
@@ -185,12 +197,18 @@ class DistributedDataParallel:
         exactly torch-DDP's model. (Without this, shard_map's AD would
         auto-``psum`` cotangents of replicated params and an explicit sync
         would double-count.)
+
+        With ``delay_allreduce=True`` the grads come back UNSYNCED (still
+        per-replica, ``no_sync`` semantics) — the caller owns firing
+        :meth:`sync_gradients` once per accumulation window.
         """
         def wrapped(params, *args, **kwargs):
             params = jax.tree_util.tree_map(
                 lambda p: cast_to_vma(p, frozenset({self.axis_name})), params)
             value, grads = jax.value_and_grad(loss_fn, **vag_kwargs)(
                 params, *args, **kwargs)
+            if self.delay_allreduce:
+                return value, grads
             return value, self.sync_gradients(grads)
 
         return wrapped

@@ -24,10 +24,54 @@ from apex_tpu.parallel.distributed import allreduce_grads
 from apex_tpu.transformer.amp import GradScaler
 from apex_tpu.transformer.pipeline_parallel import (
     forward_backward_pipelining_without_interleaving)
-from apex_tpu.utils.compat import shard_map_unchecked
-from apex_tpu.utils.vma import cast_to_vma
+from apex_tpu.utils.compat import HAS_VMA, shard_map_unchecked
+from apex_tpu.utils.vma import cast_to_vma, scan_stable_vma
 
-__all__ = ["GPTHybridTrainer"]
+__all__ = ["GPTHybridTrainer", "accumulate_gradients"]
+
+
+def accumulate_gradients(ddp, loss_fn, params, microbatches):
+    """Gradient accumulation with one DDP allreduce per window — the real
+    implementation of ``DistributedDataParallel(delay_allreduce=True)``
+    (apex's ``distributed.py:162`` flag; torch-DDP ``no_sync`` semantics).
+
+    ``loss_fn(params, microbatch) -> scalar``; ``microbatches`` is a pytree
+    of arrays with a leading accumulation axis ``K``. Each microbatch is
+    differentiated with per-replica (unsynced) grads, the K grad trees are
+    summed *locally* in a scan, and :meth:`ddp.sync_gradients
+    <apex_tpu.parallel.distributed.DistributedDataParallel.sync_gradients>`
+    fires exactly once on the mean — so the jaxpr carries one psum per
+    window instead of K (asserted by
+    ``tests/test_parallel.py::test_accumulate_gradients_single_psum``),
+    cutting DP traffic by K× at identical numerics (grad of the mean loss
+    over the window, then DDP's numeric policy).
+
+    Must run where ``ddp.axis_name`` is bound. Returns ``(mean_loss,
+    synced_grads)``; the loss is this replica's local window mean (pmean it
+    over the data axis if a replicated value is needed).
+    """
+    params_v = jax.tree_util.tree_map(
+        lambda p: cast_to_vma(p, frozenset({ddp.axis_name})), params)
+    leading = {jnp.shape(l)[0]
+               for l in jax.tree_util.tree_leaves(microbatches)}
+    if len(leading) != 1:
+        raise ValueError(
+            f"microbatch leaves disagree on the accumulation axis: "
+            f"{sorted(leading)}")
+    num_micro = leading.pop()
+
+    def body(carry, mb):
+        acc, loss_sum = carry
+        loss, grads = jax.value_and_grad(loss_fn)(params_v, mb)
+        acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+        return (acc, loss_sum + loss), None
+
+    zeros = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(jnp.shape(p), jnp.result_type(p)), params_v)
+    (acc, loss_sum), _ = scan_stable_vma(
+        body, (zeros, jnp.zeros((), jnp.float32)), microbatches)
+    mean_grads = jax.tree_util.tree_map(lambda g: g / num_micro, acc)
+    return loss_sum / num_micro, ddp.sync_gradients(mean_grads)
 
 
 class GPTHybridTrainer:
@@ -51,6 +95,23 @@ class GPTHybridTrainer:
         self.mesh = mesh
         self.pp = cfg.parallel.pipeline_model_parallel_size
         self.model = cfg.build_model()
+        if (getattr(self.model.cfg, "sequence_parallel", False)
+                and not HAS_VMA):
+            # The step runs under shard_map_unchecked, which relaxes
+            # check_rep on pre-VMA 0.4.x — and with neither the VMA
+            # replication rewrite nor the 0.4.x check_rep rewrite active,
+            # the SP-split computation hands tensor-replicated params
+            # (LNs, position embedding) and the SP boundary activations
+            # per-rank PARTIAL cotangents: the loss is exact but the
+            # gradients are silently wrong (the degradation class
+            # documented in utils/compat.py). Refuse loudly instead.
+            raise NotImplementedError(
+                "sequence_parallel through GPTHybridTrainer requires "
+                "VMA jax (the replication rewrite that supplies the "
+                "tensor-axis psums of replicated-param cotangents); this "
+                f"jax {jax.__version__} would train on silently wrong "
+                "LN/position-embedding grads. Use the model-level SP path "
+                "(plain shard_map, full checking) on this jax, or upgrade.")
         self.opt = cfg.build_optimizer()
         # ZeRO (OptimizerConfig.zero): DistributedFused* shards optimizer
         # state 1/dp over the data axis — its init/step run inside the
@@ -134,6 +195,16 @@ class GPTHybridTrainer:
         def body(stage_stack, shared, opt_state, ls, tokens, targets):
             # rebuild the pipeline closures over THIS dp-rank's targets
             stage, embed_fn, head_fn, _, _ = model.pipeline_fns(pp, targets)
+            if getattr(model.cfg, "tp_comm_overlap", False):
+                # the pipelined path runs the layer stack via stage_fn (not
+                # transform()), so the tp/* ring telemetry is recorded here:
+                # M microbatch passes on a (mb, s/tp, h) activation shard
+                mcfg = model.cfg
+                model.record_tp_overlap(
+                    (tokens.shape[1],
+                     tokens.shape[2] // mcfg.tensor_model_parallel_size,
+                     mcfg.hidden_size),
+                    passes=tokens.shape[0])
             # DDP pattern: params enter the differentiated region
             # data-VARYING so AD yields per-replica grads, averaged
             # explicitly below (the instrumented DDP allreduce)

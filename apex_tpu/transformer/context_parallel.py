@@ -201,7 +201,8 @@ def gather_from_sequence_parallel_region(x: jnp.ndarray,
     get attributed per-rank and differ from the TP=1 semantics (see
     tests/test_models.py::test_gpt_sequence_parallel_matches_tp)."""
     if not invariant:
-        return jax.lax.all_gather(x, axis_name, axis=seq_axis, tiled=True)
+        from apex_tpu.utils.vma import varying_all_gather
+        return varying_all_gather(x, axis_name, axis=seq_axis, tiled=True)
     from apex_tpu.utils.vma import invariant_all_gather
     return invariant_all_gather(x, axis_name, axis=seq_axis)
 

@@ -1,5 +1,7 @@
 """Tensor-parallel toolkit (``reference:apex/transformer/tensor_parallel/``)."""
 
+from apex_tpu.transformer.tensor_parallel.collective_matmul import (  # noqa: F401,E501
+    all_gather_matmul, matmul_reduce_scatter)
 from apex_tpu.transformer.tensor_parallel.cross_entropy import (  # noqa: F401
     vocab_parallel_cross_entropy)
 from apex_tpu.transformer.tensor_parallel.data import (  # noqa: F401
@@ -18,6 +20,7 @@ from apex_tpu.transformer.tensor_parallel.random import (  # noqa: F401
     RNGStatesTracker, checkpoint, get_rng_tracker, model_parallel_seed)
 
 __all__ = [
+    "all_gather_matmul", "matmul_reduce_scatter",
     "vocab_parallel_cross_entropy",
     "broadcast_data", "broadcast_from_tensor_parallel_rank0",
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",

@@ -10,10 +10,15 @@ overlaps the input-grad allreduce with the weight-grad GEMM.
 TPU redesign: layers are param factories whose ``__call__`` runs inside
 ``shard_map`` with *per-device weight shards* (Column: ``(out/tp, in)``,
 Row: ``(out, in/tp)``, Embedding: ``(vocab/tp, h)``). The collectives come
-from :mod:`.mappings`; the async-allreduce/wgrad overlap of :285-304 needs no
-code — XLA's latency-hiding scheduler overlaps the backward psum with the
-wgrad dot, which is exactly what the hand-rolled
-``handle = allreduce(async_op=True) ... handle.wait()`` achieved. The
+from :mod:`.mappings`; for *independent* collectives the async-allreduce/
+wgrad overlap of :285-304 needs no code — XLA's latency-hiding scheduler
+overlaps the backward psum with the wgrad dot, which is exactly what the
+hand-rolled ``handle = allreduce(async_op=True) ... handle.wait()``
+achieved. The sequence-parallel hot path is the exception: its
+all-gather→GEMM / GEMM→reduce-scatter pairs are *dependent*, which no
+scheduler can overlap without decomposition — ``tp_comm_overlap=True``
+swaps them for the ring-decomposed primitives of
+:mod:`.collective_matmul` (fwd and bwd overlap, same numerics). The
 ``gradient_accumulation_fusion`` flag (accumulate wgrad into a persistent
 fp32 ``main_grad``, :493-508) is a donation/accumulation concern of the
 caller's optimizer loop here, so both flags are accepted and documented
@@ -121,7 +126,8 @@ class ColumnParallelLinear:
                  world_size: Optional[int] = None,
                  no_async_tensor_model_parallel_allreduce: bool = False,
                  gradient_accumulation_fusion: bool = False,
-                 sequence_parallel: bool = False, seq_axis: int = 1):
+                 sequence_parallel: bool = False, seq_axis: int = 1,
+                 tp_comm_overlap: bool = False):
         self.input_size = input_size
         self.output_size = output_size
         self.use_bias = bias
@@ -137,6 +143,12 @@ class ColumnParallelLinear:
         # the input cotangents, the SP backward)
         self.sequence_parallel = sequence_parallel
         self.seq_axis = seq_axis
+        # ring-decompose the SP gather under the GEMM (collective_matmul)
+        if tp_comm_overlap and not sequence_parallel:
+            raise ValueError(
+                "tp_comm_overlap requires sequence_parallel=True: only the "
+                "SP gather->GEMM pair is a dependent collective")
+        self.tp_comm_overlap = tp_comm_overlap
 
     def init(self, key: jax.Array) -> dict:
         # master weight then split along out dim (:56-151)
@@ -153,15 +165,26 @@ class ColumnParallelLinear:
     def __call__(self, params: dict, x: jnp.ndarray
                  ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
         w = _local_shard(params["weight"], self.world_size)
-        if self.world_size > 1:
-            if self.sequence_parallel:
-                from apex_tpu.transformer.context_parallel import (
-                    gather_from_sequence_parallel_region)
-                x = gather_from_sequence_parallel_region(
-                    x, TENSOR_AXIS, self.seq_axis, invariant=True)
-            else:
-                x = copy_to_tensor_model_parallel_region(x)
-        out = _dense(x, w).astype(x.dtype)
+        if (self.world_size > 1 and self.sequence_parallel
+                and self.tp_comm_overlap):
+            # dependent-collective overlap: the sequence all-gather is
+            # ring-decomposed under the partial GEMMs (and its backward
+            # reduce-scatter under the dW GEMM) — same math, same fp32
+            # MXU accumulation, tp-1 ppermutes instead of one fused gather
+            from apex_tpu.transformer.tensor_parallel.collective_matmul \
+                import all_gather_matmul
+            out = all_gather_matmul(x, w, TENSOR_AXIS,
+                                    self.seq_axis).astype(x.dtype)
+        else:
+            if self.world_size > 1:
+                if self.sequence_parallel:
+                    from apex_tpu.transformer.context_parallel import (
+                        gather_from_sequence_parallel_region)
+                    x = gather_from_sequence_parallel_region(
+                        x, TENSOR_AXIS, self.seq_axis, invariant=True)
+                else:
+                    x = copy_to_tensor_model_parallel_region(x)
+            out = _dense(x, w).astype(x.dtype)
         b = None
         if self.use_bias:
             b = _local_shard(params["bias"], self.world_size)
@@ -191,7 +214,8 @@ class RowParallelLinear:
                  init_method: Optional[Callable] = None,
                  skip_bias_add: bool = False, params_dtype=jnp.float32,
                  world_size: Optional[int] = None,
-                 sequence_parallel: bool = False, seq_axis: int = 1):
+                 sequence_parallel: bool = False, seq_axis: int = 1,
+                 tp_comm_overlap: bool = False):
         self.input_size = input_size
         self.output_size = output_size
         self.use_bias = bias
@@ -204,6 +228,12 @@ class RowParallelLinear:
         self.input_size_per_partition = divide(input_size, self.world_size)
         self.sequence_parallel = sequence_parallel
         self.seq_axis = seq_axis
+        # ring-decompose the SP reduce-scatter under the GEMM
+        if tp_comm_overlap and not sequence_parallel:
+            raise ValueError(
+                "tp_comm_overlap requires sequence_parallel=True: only the "
+                "SP GEMM->reduce-scatter pair is a dependent collective")
+        self.tp_comm_overlap = tp_comm_overlap
 
     def init(self, key: jax.Array) -> dict:
         master = self.init_method(key, (self.output_size, self.input_size))
@@ -224,9 +254,9 @@ class RowParallelLinear:
         w = _local_shard(params["weight"], self.world_size)
         if not self.input_is_parallel and self.world_size > 1:
             x = scatter_to_tensor_model_parallel_region(x)
-        partial = _dense(x, w).astype(x.dtype)
         b = _local_shard(params["bias"], self.world_size) if self.use_bias \
             else None
+        fold = None
         if b is not None and not self.skip_bias_add:
             # Forward folds b/tp into the pre-psum partial so the psum adds
             # the bias exactly once without up-casting its (replicated)
@@ -237,8 +267,22 @@ class RowParallelLinear:
             # run), so _scale_grad restores the reference semantics
             # (:649-657, bias added after reduce → full grad per copy).
             b_fold = _scale_grad(b.astype(jnp.float32), self.world_size)
-            partial = partial + (b_fold / self.world_size).astype(partial.dtype)
+            fold = b_fold / self.world_size
             b = None
+        if (self.world_size > 1 and self.sequence_parallel
+                and self.tp_comm_overlap):
+            # dependent-collective overlap: the GEMM is chunked along the
+            # sequence and each partial's transfer (ring reduce-scatter)
+            # rides under the next chunk's GEMM; the bias fold becomes the
+            # primitive's partial_add (same once-per-psum semantics)
+            from apex_tpu.transformer.tensor_parallel.collective_matmul \
+                import matmul_reduce_scatter
+            out = matmul_reduce_scatter(
+                x, w, fold, TENSOR_AXIS, self.seq_axis).astype(x.dtype)
+            return out, b
+        partial = _dense(x, w).astype(x.dtype)
+        if fold is not None:
+            partial = partial + fold.astype(partial.dtype)
         if self.world_size > 1:
             if self.sequence_parallel:
                 # SP: the reduction scatters — each rank keeps its sequence

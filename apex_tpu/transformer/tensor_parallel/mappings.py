@@ -56,6 +56,12 @@ def reduce_from_tensor_model_parallel_region(x):
 
 def _split_local(x):
     tp = _axis_size(TENSOR_AXIS)
+    if x.shape[-1] % tp:
+        # a floor-divide here would silently drop the trailing
+        # x.shape[-1] % tp elements on every rank
+        raise ValueError(
+            f"scatter_to_tensor_model_parallel_region: last dim of size "
+            f"{x.shape[-1]} is not divisible by tensor parallel size {tp}")
     rank = jax.lax.axis_index(TENSOR_AXIS)
     chunk = x.shape[-1] // tp
     return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=-1)
@@ -71,5 +77,5 @@ def gather_from_tensor_model_parallel_region(x):
     """All-gather along the last dim forward; transpose = reduce-scatter,
     which for the replicated cotangents of TP training is the reference's
     take-own-slice backward (:127-140)."""
-    return jax.lax.all_gather(_vary(x), TENSOR_AXIS, axis=x.ndim - 1,
-                              tiled=True)
+    from apex_tpu.utils.vma import varying_all_gather
+    return varying_all_gather(x, TENSOR_AXIS, axis=x.ndim - 1, tiled=True)

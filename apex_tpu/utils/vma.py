@@ -19,6 +19,7 @@ from apex_tpu.utils.compat import HAS_VMA
 from apex_tpu.utils.compat import axis_size as _axis_size
 
 __all__ = ["cast_to_vma", "scan_stable_vma", "invariant_all_gather",
+           "varying_all_gather",
            "reconcile_cotangent", "restore_invariant", "leaf_vma",
            "fixed_point_vma"]
 
@@ -125,6 +126,23 @@ def scan_stable_vma(body: Callable, init: Any, xs: Any, max_iters: int = 4):
     return jax.lax.scan(
         stable_body, jax.tree_util.tree_map(cast_to_vma, init, vma_tree),
         xs)
+
+
+def varying_all_gather(x: jnp.ndarray, axis_name: str, axis: int = 0,
+                       tiled: bool = True) -> jnp.ndarray:
+    """``lax.all_gather`` with the input pre-cast device-varying — the
+    library's single raw-gather chokepoint.
+
+    On VMA jax a replicated-typed value cannot feed ``all_gather`` directly
+    (the op demands a varying operand); on pre-VMA 0.4.x the cast is an
+    identity and this is a plain ``all_gather``. Every gather outside this
+    module must route here (or through :func:`invariant_all_gather`) so the
+    version shim lives in exactly one place —
+    ``scripts/check_collectives.py`` (wired into the test suite) flags raw
+    ``lax.all_gather`` call sites anywhere else.
+    """
+    return jax.lax.all_gather(cast_to_vma(x, frozenset({axis_name})),
+                              axis_name, axis=axis, tiled=tiled)
 
 
 def invariant_all_gather(x: jnp.ndarray, axis_name: str, axis: int = 0

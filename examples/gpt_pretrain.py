@@ -38,7 +38,17 @@ def main(argv=None):
                     help="shard optimizer state 1/dp over the data axis "
                          "(DistributedFusedAdam; reduce_scatter grads, "
                          "all_gather params)")
+    ap.add_argument("--sequence-parallel", action="store_true",
+                    help="Megatron-LM sequence parallelism (tp > 1, "
+                         "pp == 1, VMA jax — the trainer refuses on the "
+                         "pre-VMA 0.4.x line)")
+    ap.add_argument("--tp-comm-overlap", action="store_true",
+                    help="ring-decomposed SP collectives overlapping "
+                         "their GEMMs (implies --sequence-parallel; see "
+                         "docs/PERF.md)")
     args = ap.parse_args(argv)
+    if args.tp_comm_overlap:
+        args.sequence_parallel = True
 
     tp, pp = args.tp, args.pp
     dp = jax.device_count() // (tp * pp)
@@ -48,7 +58,9 @@ def main(argv=None):
                           hidden_size=args.hidden,
                           num_layers=args.layers_per_stage * pp,
                           num_attention_heads=4,
-                          max_position_embeddings=seq),
+                          max_position_embeddings=seq,
+                          sequence_parallel=args.sequence_parallel,
+                          tp_comm_overlap=args.tp_comm_overlap),
         parallel=ParallelConfig(tensor_model_parallel_size=tp,
                                 pipeline_model_parallel_size=pp),
         batch=BatchConfig(global_batch_size=M * mb * dp,

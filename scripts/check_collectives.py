@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Static check: no raw ``lax.all_gather`` outside the VMA-safe wrappers.
+
+Gathers are the one collective whose semantics changed across the jax
+version line this library straddles: on VMA jax ``all_gather`` demands a
+device-varying operand (a replicated-typed value must be ``pcast`` first)
+and there is a separate invariant-typed gather, while on the pre-VMA 0.4.x
+line neither concept exists. ``apex_tpu.utils.vma`` owns both shims
+(:func:`varying_all_gather`, :func:`invariant_all_gather`); a raw
+``jax.lax.all_gather`` sprinkled anywhere else silently works on one
+version and breaks on the other. This script greps the package for stray
+call sites — no jax import, pre-commit fast — and exits non-zero listing
+any. Wired into the test suite via
+``tests/test_observability.py::TestCheckCollectives``.
+
+Usage::
+
+    python scripts/check_collectives.py          # check, report, exit 0/1
+    python scripts/check_collectives.py --list   # print the policy
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = "apex_tpu"
+
+# the only modules allowed to touch lax.all_gather directly: the VMA shims
+# themselves and the version-compat layer
+ALLOWED = {
+    os.path.join("apex_tpu", "utils", "vma.py"),
+    os.path.join("apex_tpu", "utils", "compat.py"),
+}
+
+# `lax.all_gather(` catches `jax.lax.all_gather(` and `from jax import lax;
+# lax.all_gather(`; the word boundary keeps `all_gather_invariant` (the
+# private symbol vma.py wraps) and mention-in-docstring text like
+# "all_gather the shards" out
+_PATTERN = re.compile(r"lax\.all_gather\s*\(")
+
+
+def check(repo: str = REPO):
+    """Returns (ok, report_lines)."""
+    lines = []
+    ok = True
+    pkg_root = os.path.join(repo, PACKAGE)
+    for dirpath, _dirnames, filenames in sorted(os.walk(pkg_root)):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, repo)
+            with open(path) as f:
+                source = f.read()
+            hits = [i + 1 for i, line in enumerate(source.splitlines())
+                    if _PATTERN.search(line)]
+            if not hits:
+                continue
+            if rel in ALLOWED:
+                lines.append(f"ok       {rel}: wrapper module "
+                             f"(lines {', '.join(map(str, hits))})")
+            else:
+                ok = False
+                for ln in hits:
+                    lines.append(
+                        f"RAW      {rel}:{ln}: lax.all_gather outside the "
+                        f"VMA-safe wrappers — use "
+                        f"apex_tpu.utils.vma.varying_all_gather (or "
+                        f"invariant_all_gather)")
+    return ok, lines
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--list" in argv:
+        print("allowed lax.all_gather call sites:")
+        for rel in sorted(ALLOWED):
+            print(f"  {rel}")
+        return 0
+    ok, lines = check()
+    for line in lines:
+        print(line)
+    if not ok:
+        print("raw all_gather call sites found — route them through "
+              "apex_tpu/utils/vma.py so the pre-VMA 0.4.x path keeps "
+              "working (or extend ALLOWED in scripts/check_collectives.py "
+              "with justification)", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -638,3 +638,35 @@ class TestCheckAnnotations:
         assert sum("MISSING" in l for l in lines) == len(mod.ANNOTATIONS)
         ok, _ = mod.check()
         assert ok
+
+
+# ---------------------------------------------------------------------------
+# collective-routing contract (raw all_gather outside the VMA wrappers)
+# ---------------------------------------------------------------------------
+
+class TestCheckCollectives:
+    def test_script_passes_on_this_tree(self):
+        proc = subprocess.run(
+            [sys.executable, "scripts/check_collectives.py"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_detects_raw_all_gather(self, tmp_path):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "check_collectives", "scripts/check_collectives.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        # plant a stray raw gather in a fake package tree
+        pkg = tmp_path / "apex_tpu" / "transformer"
+        pkg.mkdir(parents=True)
+        (pkg / "bad.py").write_text(
+            "import jax\n"
+            "def f(x):\n"
+            "    return jax.lax.all_gather(x, 'tensor', axis=0)\n")
+        ok, lines = mod.check(repo=str(tmp_path))
+        assert not ok
+        assert any("bad.py:3" in l for l in lines)
+        # the real tree stays clean (wrapper modules allowlisted)
+        ok, lines = mod.check()
+        assert ok, "\n".join(lines)

@@ -267,3 +267,103 @@ def test_syncbn_apply_dtype_matches_fp32_path():
     g = jax.grad(loss)(x)
     assert g.dtype == jnp.bfloat16
     assert bool(jnp.all(jnp.isfinite(g.astype(jnp.float32))))
+
+
+# ---------------------------------------------------------------------------
+# delay_allreduce / gradient accumulation (apex no_sync semantics)
+# ---------------------------------------------------------------------------
+
+def test_delay_allreduce_returns_unsynced_grads():
+    """DDP(delay_allreduce=True) is real: value_and_grad skips the inline
+    sync (zero psums in its jaxpr) and returns per-replica grads."""
+    mesh = _mesh()
+    x = jnp.asarray(np.random.RandomState(3).randn(16, 4), jnp.float32)
+    y = jnp.asarray(np.random.RandomState(4).randn(16, 1), jnp.float32)
+    w = jnp.asarray(np.random.RandomState(5).randn(4, 1), jnp.float32)
+
+    def loss_fn(w, x, y):
+        return jnp.mean((x @ w - y) ** 2)
+
+    def run(ddp, stacked):
+        def wrapped(w, x, y):
+            def inner(w, x, y):
+                g = ddp.value_and_grad(loss_fn)(w, x, y)[1]
+                # unsynced grads are per-rank: stack them on a sharded
+                # leading axis to bring every replica's copy out
+                return g[None] if stacked else g
+            return shard_map(
+                inner, mesh=mesh, in_specs=(P(), P("data"), P("data")),
+                out_specs=P("data") if stacked else P())(w, x, y)
+        return wrapped
+
+    delayed = run(DistributedDataParallel(axis_name="data",
+                                          delay_allreduce=True), True)
+    synced = run(DistributedDataParallel(axis_name="data"), False)
+    # the delayed jaxpr has no psum; the synced one has exactly one
+    assert str(jax.make_jaxpr(delayed)(w, x, y)).count("psum") == 0
+    assert str(jax.make_jaxpr(synced)(w, x, y)).count("psum") == 1
+    # and its value is each replica's own-shard grad, not the mean
+    g_delay = jax.jit(delayed)(w, x, y)  # (8, 4, 1): per-rank grads
+    g_sync = jax.jit(synced)(w, x, y)
+    assert g_delay.shape == (8, 4, 1)
+    per_rank = np.stack([
+        np.asarray(jax.grad(loss_fn)(w, x[i * 2:(i + 1) * 2],
+                                     y[i * 2:(i + 1) * 2]))
+        for i in range(8)])
+    np.testing.assert_allclose(np.asarray(g_delay).reshape(8, 4, 1),
+                               per_rank, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(g_sync), per_rank.mean(0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_accumulate_gradients_single_psum():
+    """The gradient-accumulation window fires exactly ONE allreduce: the
+    jaxpr over K microbatches holds a single psum (vs K for per-microbatch
+    sync), and the result equals the full-batch DDP grads."""
+    from apex_tpu.training import accumulate_gradients
+
+    mesh = _mesh()
+    rng = np.random.RandomState(6)
+    K = 3
+    w = jnp.asarray(rng.randn(4, 2), jnp.float32)
+    xs = jnp.asarray(rng.randn(K, 16, 4), jnp.float32)
+    ys = jnp.asarray(rng.randn(K, 16, 2), jnp.float32)
+
+    def loss_fn(w, mb):
+        x, y = mb
+        return jnp.mean((x @ w - y) ** 2)
+
+    ddp = DistributedDataParallel(axis_name="data", delay_allreduce=True)
+
+    def run(w, xs, ys):
+        def inner(w, xs, ys):
+            loss, grads = accumulate_gradients(ddp, loss_fn, w, (xs, ys))
+            # the window loss is rank-local: bring the replicas out stacked
+            return jnp.reshape(loss, (1,)), grads
+        return shard_map(inner, mesh=mesh,
+                         in_specs=(P(), P(None, "data"), P(None, "data")),
+                         out_specs=(P("data"), P()))(w, xs, ys)
+
+    # exactly one psum per accumulation window (single-leaf params)
+    assert str(jax.make_jaxpr(run)(w, xs, ys)).count("psum") == 1
+
+    _, g = jax.jit(run)(w, xs, ys)
+
+    # reference: grad of the mean loss over all K x full-batch samples
+    def ref_loss(w):
+        return jnp.mean(jax.vmap(
+            lambda x, y: jnp.mean((x @ w - y) ** 2))(xs, ys))
+
+    gr = jax.grad(ref_loss)(w)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_accumulate_gradients_rejects_ragged_microbatches():
+    from apex_tpu.training import accumulate_gradients
+
+    ddp = DistributedDataParallel(axis_name="data", delay_allreduce=True)
+    w = jnp.zeros((2, 2))
+    with pytest.raises(ValueError, match="accumulation axis"):
+        accumulate_gradients(ddp, lambda w, mb: jnp.sum(w), w,
+                             (jnp.zeros((3, 2)), jnp.zeros((4, 2))))
