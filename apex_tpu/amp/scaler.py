@@ -21,6 +21,7 @@ from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.observability import health as _health
 from apex_tpu.observability import ingraph as _metrics
 
 __all__ = [
@@ -46,7 +47,8 @@ class LossScaleState(NamedTuple):
     unskipped: jnp.ndarray   # i32 scalar
 
 
-def all_finite(tree: Any, axis_names: Union[None, str, Sequence[str]] = None) -> jnp.ndarray:
+def all_finite(tree: Any, axis_names: Union[None, str, Sequence[str]] = None,
+               observe: Optional[str] = "grads") -> jnp.ndarray:
     """Single fused bool: every float leaf in ``tree`` is finite.
 
     The equivalent of the ``noop_flag`` overflow buffer threaded through every
@@ -57,7 +59,19 @@ def all_finite(tree: Any, axis_names: Union[None, str, Sequence[str]] = None) ->
     When called inside ``shard_map`` with explicit model-parallel axes, pass
     ``axis_names`` to reduce the flag across the model-parallel group, matching
     ``transformer.amp.GradScaler`` (``reference:apex/transformer/amp/grad_scaler.py:38-49``).
+
+    ``observe`` names the tree for the health watchdog (the amp grad-check
+    default "grads" gives overflow steps per-leaf attribution); callers
+    finite-checking a NON-gradient tree (``multi_tensor_apply`` outputs)
+    must pass a distinct name or None, or their records would sum into —
+    and mis-attribute — ``health/grads/*``.
     """
+    # the health watchdog hangs off the same tree this check consumes, so
+    # amp's overflow signal carries per-leaf attribution
+    # (health/grads/first_nonfinite_leaf names the offending leaf) when a
+    # policy is active — a trace-time-gated no-op otherwise
+    if observe is not None:
+        _health.observe_tree(tree, observe)
     leaves = [x for x in jax.tree_util.tree_leaves(tree)
               if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)]
     if not leaves:

@@ -92,6 +92,17 @@ class TrainConfig:
     opt_level: str = "O2"             # amp policy preset
     half_dtype: str = "bfloat16"
     seed: int = 1234
+    # numerics watchdog (observability.health): in-graph instrumentation
+    # tier ("off" is provably zero-cost) + host reaction to a non-finite
+    # step ("skip" keeps amp's silent select-skip; "dump"/"raise" write a
+    # structured CrashDump via the StepReporter health hook).
+    # health_consecutive: fire raise/dump only after N non-finite reports
+    # in a row — fp16 + dynamic loss scaling should set >= 2, because the
+    # scaler's growth calibration overflows by design (see HealthConfig)
+    health_level: str = "off"         # off | cheap | full
+    health_on_nonfinite: str = "skip"  # raise | dump | skip
+    health_consecutive: int = 1
+    health_dump_dir: str = "."
 
     # -- serialization ----------------------------------------------------
     def to_dict(self) -> dict:
@@ -126,6 +137,15 @@ class TrainConfig:
         """Loss-scale object implied by the policy (may be a no-op)."""
         from apex_tpu.amp import make_loss_scale
         return make_loss_scale(self.build_policy().loss_scale)
+
+    def build_health(self):
+        """The numerics-watchdog policy (level "off" by default — the
+        provably-free tier)."""
+        from apex_tpu.observability.health import HealthConfig
+        return HealthConfig(level=self.health_level,
+                            on_nonfinite=self.health_on_nonfinite,
+                            consecutive=self.health_consecutive,
+                            dump_dir=self.health_dump_dir)
 
     def build_model(self):
         import jax.numpy as jnp

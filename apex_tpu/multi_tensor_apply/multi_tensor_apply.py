@@ -52,7 +52,10 @@ def multi_tensor_scale(tree: Any, scale: Any) -> Tuple[Any, jnp.ndarray]:
         return (x.astype(jnp.float32) * scale).astype(x.dtype)
 
     out = jax.tree_util.tree_map(_scale, tree)
-    return out, all_finite(out)
+    # observe=None: these are scaled/blended OUTPUT trees, not the
+    # amp grad check — recording them as "grads" would corrupt the
+    # health watchdog's counts and leaf attribution
+    return out, all_finite(out, observe=None)
 
 
 def multi_tensor_axpby(a: Any, x_tree: Any, b: Any, y_tree: Any,
@@ -71,7 +74,10 @@ def multi_tensor_axpby(a: Any, x_tree: Any, b: Any, y_tree: Any,
         return out.astype(out_dtype or x.dtype)
 
     out = jax.tree_util.tree_map(_axpby, x_tree, y_tree)
-    return out, all_finite(out)
+    # observe=None: these are scaled/blended OUTPUT trees, not the
+    # amp grad check — recording them as "grads" would corrupt the
+    # health watchdog's counts and leaf attribution
+    return out, all_finite(out, observe=None)
 
 
 def tree_per_tensor_norms(tree: Any, ord: int = 2) -> Any:

@@ -6,7 +6,7 @@ The reference exposes runtime behavior only through ad-hoc prints (amp's
 structured replacement: one stream that answers "what did this step spend,
 where, on which rank" without a trace capture.
 
-Four layers, composable and each zero-cost when unused:
+Six modules, composable and each zero-cost when unused:
 
 - :mod:`~apex_tpu.observability.registry` — host-side counters, gauges and
   fixed-bucket histograms (``Metric.observe()``), grouped in a
@@ -21,12 +21,19 @@ Four layers, composable and each zero-cost when unused:
   ``add_scalar`` writers, Chrome-trace span export);
 - :mod:`~apex_tpu.observability.runtime` — compile/recompile counters via
   ``jax.monitoring`` listeners and a ``memory_stats()`` gauge sampler, so
-  recompilation storms and HBM growth land in the same stream.
+  recompilation storms and HBM growth land in the same stream;
+- :mod:`~apex_tpu.observability.health` — the numerics watchdog: per-leaf
+  NaN/overflow attribution (``health/*``), replica-agreement checks, and
+  the :class:`HealthConfig` policy whose :class:`HealthMonitor` reporter
+  hook raises or writes a structured :class:`CrashDump` on a non-finite
+  step;
+- :mod:`~apex_tpu.observability.costs` — the peak-flops table and MFU
+  math shared by ``bench.py`` and the reporter's ``perf/mfu`` gauge.
 
 Hot paths in the library are pre-instrumented (``amp/*``, ``ddp/*``,
-``pipeline/*``, ``optim/*`` — see ``docs/OBSERVABILITY.md``); with no
-collector active every instrumentation point is a module-level no-op that
-adds nothing to the traced program.
+``pipeline/*``, ``optim/*``, ``health/*`` — see ``docs/OBSERVABILITY.md``);
+with no collector active every instrumentation point is a module-level
+no-op that adds nothing to the traced program.
 """
 
 from apex_tpu.observability.registry import (  # noqa: F401
@@ -41,4 +48,10 @@ from apex_tpu.observability.report import (  # noqa: F401
     NullReporter, StepReporter, attach_reporter, detach_reporter,
     get_reporter)
 from apex_tpu.observability.runtime import (  # noqa: F401
-    install_compile_listeners, sample_memory_stats)
+    install_compile_listeners, reset_compile_listeners,
+    sample_memory_stats, uninstall_compile_listeners)
+from apex_tpu.observability.health import (  # noqa: F401
+    CrashDump, HealthConfig, HealthMonitor, NonFiniteError, TreeStats,
+    check_replica_agreement, decode_attribution, tensor_stats)
+from apex_tpu.observability.costs import (  # noqa: F401
+    flops_budget, mfu, peak_flops)

@@ -1,0 +1,83 @@
+"""Peak-flops table and MFU math — one source of truth.
+
+``bench.py`` grew a hand-rolled device-kind -> peak-bf16-flops table and a
+``compiled.cost_analysis()`` extraction for its MFU columns; the
+:class:`~apex_tpu.observability.report.StepReporter` wants the same number
+as a live gauge. Both now read from here:
+
+- :data:`PEAK_BF16_FLOPS` / :func:`peak_flops` — peak dense bf16 FLOP/s
+  per chip by ``device_kind`` prefix (public spec-sheet numbers);
+- :func:`flops_budget` — the per-step model FLOPs of a lowered+compiled
+  executable via XLA's cost analysis (None when the backend reports
+  nothing useful — notably, Mosaic custom calls report zero flops, so GPT
+  steps with flash attention should prefer an analytic count);
+- :func:`mfu` — model-flops-utilization: achieved FLOP/s over peak.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["PEAK_BF16_FLOPS", "DEFAULT_PEAK_FLOPS", "peak_flops",
+           "flops_budget", "mfu"]
+
+# peak dense bf16 TFLOP/s per chip by device kind (public spec sheets)
+PEAK_BF16_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,   # v6e / Trillium
+    "TPU v6e": 918e12,
+}
+
+# assume v5e-class when the device kind is unknown (CPU test hosts, new
+# chips the table has not learned yet) — conservative for MFU claims
+DEFAULT_PEAK_FLOPS = 197e12
+
+
+def peak_flops(device=None) -> float:
+    """Peak dense bf16 FLOP/s of ``device`` (default: the first visible
+    device), matched by ``device_kind`` prefix against
+    :data:`PEAK_BF16_FLOPS`; :data:`DEFAULT_PEAK_FLOPS` when unknown."""
+    if device is None:
+        import jax
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "")
+    for prefix, value in PEAK_BF16_FLOPS.items():
+        if kind.startswith(prefix):
+            return value
+    return DEFAULT_PEAK_FLOPS
+
+
+def flops_budget(compiled) -> Optional[float]:
+    """Per-execution model FLOPs of a compiled executable
+    (``jit(f).lower(...).compile()``), from XLA's cost analysis.
+
+    Returns None when the backend exposes no cost analysis or reports a
+    non-positive/non-finite count (custom calls — e.g. Mosaic flash
+    attention — report zero flops and would deflate MFU; callers should
+    fall back to an analytic count, as ``bench.py`` does).
+    """
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost["flops"])
+    except Exception:
+        return None
+    if not (0.0 < flops < float("inf")):  # rejects NaN, ±inf, <= 0
+        return None
+    return flops
+
+
+def mfu(flops_per_step: float, step_time_s: float,
+        peak: Optional[float] = None) -> float:
+    """Model-flops-utilization: ``flops_per_step / step_time_s / peak``
+    (``peak`` defaults to :func:`peak_flops` of the first device)."""
+    if peak is None:
+        peak = peak_flops()
+    if step_time_s <= 0.0 or peak <= 0.0:
+        raise ValueError("step_time_s and peak must be positive")
+    return flops_per_step / step_time_s / peak

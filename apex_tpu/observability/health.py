@@ -1,0 +1,592 @@
+"""Numerics health watchdog: per-leaf NaN/overflow attribution, replica
+agreement, and structured crash dumps.
+
+Apex exists for mixed precision, and mixed precision fails in exactly one
+way that a loss curve cannot explain: some tensor, on some replica, left
+the representable range first, and everything downstream is noise. The amp
+scaler's single all-finite bool (:func:`apex_tpu.amp.scaler.all_finite`)
+says *that* a step overflowed; this module says *which leaf*, *how badly*,
+and *whether the replicas still agree* — the first-failure attribution
+large-scale training reports (MegaScale, arXiv:2402.15627) identify as the
+main saver of wasted accelerator-hours.
+
+Four pieces, all riding the existing telemetry spine:
+
+- :func:`tensor_stats` — ONE fused in-graph pass over a pytree computing
+  per-leaf finite-count, abs-max, squared-norm, and half-precision
+  underflow count, returned as a :class:`TreeStats` pytree (stacked
+  ``(num_leaves,)`` device vectors + static leaf paths);
+- :func:`observe_tree` — the gated recorder: folds a tree's stats into the
+  step's in-graph metrics as ``health/<tree>/*`` scalars, including
+  ``health/<tree>/first_nonfinite_leaf`` — an argmax over per-leaf
+  nonfinite flags whose device value :func:`decode_attribution` maps back
+  to the parameter/grad *path name* host-side (the paths are trace-time
+  statics, kept in a module side table);
+- :func:`check_replica_agreement` / :func:`observe_replica_agreement` — a
+  pmean-based divergence detector (max over leaves of elementwise
+  ``|x - mean_over_replicas(x)|``) for DDP/TP state, catching silent
+  replica corruption that an allreduce would average away;
+- :class:`HealthConfig` + :class:`HealthMonitor` — the policy object
+  threaded through :class:`~apex_tpu.training.GPTHybridTrainer`, the
+  optimizer base and DDP, and the host-side
+  :class:`~apex_tpu.observability.report.StepReporter` hook that reacts to
+  a non-finite step (``raise`` / ``dump`` a :class:`CrashDump` / ``skip``).
+
+**Zero-cost default.** Instrumented call sites (``amp.scaler.all_finite``,
+``OptimizerBase.step``, ``allreduce_grads``, the hybrid trainer) call the
+``observe_*`` wrappers, which check two *trace-time* gates before touching
+their arguments: an active policy at a sufficient level
+(:func:`activate` / :func:`active_level`) AND an open ingraph collector
+(:func:`~apex_tpu.observability.ingraph.recording`). With either gate shut
+they return immediately, so ``level="off"`` adds no ops, no collectives,
+and no outputs to the traced program — asserted on the jaxpr by
+``tests/test_health.py``, the same contract ``ingraph.record`` keeps.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import platform
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.observability import ingraph
+
+__all__ = [
+    "LEVELS", "ON_NONFINITE", "HealthConfig", "HealthMonitor",
+    "TreeStats", "tensor_stats", "observe_tree",
+    "check_replica_agreement", "observe_replica_agreement",
+    "decode_attribution", "leaf_paths", "payload_nonfinite",
+    "CrashDump", "NonFiniteError",
+    "activate", "active", "active_level",
+]
+
+LEVELS = ("off", "cheap", "full")
+ON_NONFINITE = ("raise", "dump", "skip")
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class HealthConfig:
+    """Numerics-watchdog policy.
+
+    ``level`` selects the in-graph instrumentation tier: ``"off"`` is
+    provably free (jaxpr-identical step), ``"cheap"`` adds the fused
+    per-tree stats + first-nonfinite attribution on the amp grad check
+    (one extra pass over the grads), ``"full"`` additionally watches the
+    post-update params and runs the replica-agreement pmeans (one
+    collective per leaf — a debugging tier, not an always-on one).
+
+    ``on_nonfinite`` is the *host-side* reaction when a reported step
+    carried non-finite values: ``"skip"`` trusts the in-graph select that
+    already dropped the update (the amp default), ``"dump"`` additionally
+    writes a :class:`CrashDump` to ``dump_dir``, ``"raise"`` writes the
+    dump and raises :class:`NonFiniteError` so the loop stops. Enforced
+    by the :class:`HealthMonitor` reporter hook.
+
+    ``consecutive`` distinguishes routine loss-scale calibration from
+    real divergence: dynamic loss scaling *deliberately* overflows every
+    ``growth_interval`` steps (scale doubles until the scaled grads leave
+    fp range, then backs off — benign, self-correcting, and recurring for
+    the whole run), so with fp16 + ``DynamicLossScale`` a policy firing
+    on every non-finite report would raise on the first calibration step
+    or dump forever. The monitor only fires after ``consecutive``
+    non-finite *reports* in a row (a clean report resets the streak); a
+    backoff clears a calibration overflow by the next step, while true
+    divergence stays non-finite. The default of 1 fires immediately —
+    right for bf16 (no scaler-driven overflow) and for the pure watchdog
+    metrics; fp16 dynamic-scale runs should set 2 or more.
+    """
+
+    level: str = "off"
+    on_nonfinite: str = "skip"
+    dump_dir: Union[str, os.PathLike] = "."
+    consecutive: int = 1
+
+    def __post_init__(self):
+        if self.level not in LEVELS:
+            raise ValueError(f"level must be one of {LEVELS}, "
+                             f"got {self.level!r}")
+        if self.on_nonfinite not in ON_NONFINITE:
+            raise ValueError(f"on_nonfinite must be one of {ON_NONFINITE}, "
+                             f"got {self.on_nonfinite!r}")
+        if self.consecutive < 1:
+            raise ValueError("consecutive must be >= 1, "
+                             f"got {self.consecutive!r}")
+
+    @property
+    def enabled(self) -> bool:
+        return self.level != "off"
+
+    def reporter_hook(self) -> "HealthMonitor":
+        """The ``StepReporter(hooks=[...])`` callable enforcing
+        ``on_nonfinite`` on every reported payload."""
+        return HealthMonitor(self)
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.stack: List[HealthConfig] = []
+
+
+_STATE = _State()
+
+
+@contextlib.contextmanager
+def activate(config: Optional[HealthConfig]):
+    """Make ``config`` the active policy for code traced inside the
+    context (``None`` or ``level="off"`` activates nothing — the gates
+    stay shut and instrumentation stays absent from the program)."""
+    if config is None or not config.enabled:
+        yield
+        return
+    _STATE.stack.append(config)
+    try:
+        yield
+    finally:
+        popped = _STATE.stack.pop()
+        assert popped is config
+
+
+def active() -> Optional[HealthConfig]:
+    """The innermost active policy, or None."""
+    return _STATE.stack[-1] if _STATE.stack else None
+
+
+def active_level() -> str:
+    cfg = active()
+    return cfg.level if cfg is not None else "off"
+
+
+def _live(min_level: str) -> bool:
+    """Both trace-time gates: a policy at >= ``min_level`` is active AND
+    an ingraph collector is open to carry the scalars out of the step."""
+    cfg = active()
+    if cfg is None or LEVELS.index(cfg.level) < LEVELS.index(min_level):
+        return False
+    return ingraph.recording()
+
+
+# ---------------------------------------------------------------------------
+# the fused per-leaf stats pass
+# ---------------------------------------------------------------------------
+
+def _float_leaves_with_paths(tree: Any):
+    leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for kp, x in leaves:
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            out.append((jax.tree_util.keystr(kp), x))
+    return out
+
+
+@jax.tree_util.register_pytree_node_class
+class TreeStats:
+    """Per-leaf numerics summary of one pytree: four stacked
+    ``(num_leaves,)`` fp32 device vectors plus the static leaf paths and
+    element counts (which travel in the treedef, so reusing the same tree
+    structure hits the same compilation cache entry).
+
+    ``finite_count[i]`` counts finite elements of leaf ``i`` — in int32,
+    NOT fp32: an fp32 count is exact only to 2^24, so a single NaN in a
+    larger leaf (any realistic embedding table) would round away and the
+    watchdog would miss exactly the leaves most likely to overflow;
+    ``abs_max[i]`` is its max |x| (NaN-propagating — a NaN leaf reads as
+    NaN, which is itself the signal); ``sq_sum[i]`` the fp32 sum of
+    squares (``l2`` takes the sqrt of the total); ``underflow_count[i]``
+    counts (int32) nonzero half-precision elements below the dtype's
+    smallest normal (fp16 ``tiny`` = 6.1e-5, bf16 shares fp32's
+    1.18e-38) — the gradient-underflow fraction dynamic loss scaling
+    exists to fight. Per-leaf exactness holds to 2^31 elements per leaf;
+    the *aggregated* counts are f32 metrics, approximate above 2^24 but
+    still exactly zero/nonzero (sums of non-negative per-leaf values).
+    """
+
+    def __init__(self, paths: Tuple[str, ...], sizes: Tuple[int, ...],
+                 half_mask: Tuple[bool, ...],
+                 finite_count, abs_max, sq_sum, underflow_count):
+        self.paths = tuple(paths)
+        self.sizes = tuple(int(s) for s in sizes)
+        self.half_mask = tuple(bool(h) for h in half_mask)
+        self.finite_count = finite_count
+        self.abs_max = abs_max
+        self.sq_sum = sq_sum
+        self.underflow_count = underflow_count
+
+    def tree_flatten(self):
+        return ((self.finite_count, self.abs_max, self.sq_sum,
+                 self.underflow_count),
+                (self.paths, self.sizes, self.half_mask))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        paths, sizes, half_mask = aux
+        return cls(paths, sizes, half_mask, *children)
+
+    # -- aggregate views ---------------------------------------------------
+    @property
+    def num_leaves(self) -> int:
+        return len(self.paths)
+
+    @property
+    def total_size(self) -> int:
+        return sum(self.sizes)
+
+    def _nonfinite_per_leaf(self):
+        """Per-leaf non-finite counts, exact in int32 (sizes - finite)."""
+        sizes = jnp.asarray(self.sizes, jnp.int32)
+        return sizes - self.finite_count
+
+    def nonfinite_count(self):
+        """Total non-finite elements across every leaf (f32 scalar; the
+        per-leaf counts are exact, so this is exactly 0 iff clean)."""
+        return jnp.sum(self._nonfinite_per_leaf().astype(jnp.float32))
+
+    def nonfinite_flags(self):
+        """Per-leaf bool: leaf ``i`` holds at least one non-finite."""
+        return self._nonfinite_per_leaf() > 0
+
+    def first_nonfinite_index(self):
+        """Index of the first leaf (flatten order) carrying a non-finite
+        element, -1 when every leaf is clean — the device scalar
+        :func:`decode_attribution` maps back to ``paths``."""
+        flags = self.nonfinite_flags()
+        first = jnp.argmax(flags).astype(jnp.float32)
+        return jnp.where(jnp.any(flags), first, jnp.float32(-1.0))
+
+    def abs_max_total(self):
+        return jnp.max(self.abs_max)
+
+    def l2(self):
+        return jnp.sqrt(jnp.sum(self.sq_sum))
+
+    def underflow_fraction(self):
+        """Underflowed share of the tree's *half-precision* elements
+        (0 when the tree holds none)."""
+        half = sum(s for s, h in zip(self.sizes, self.half_mask) if h)
+        if half == 0:
+            return jnp.float32(0.0)
+        return (jnp.sum(self.underflow_count.astype(jnp.float32))
+                / jnp.float32(half))
+
+    def __repr__(self):
+        return (f"TreeStats({self.num_leaves} leaves, "
+                f"{self.total_size} elements)")
+
+
+def tensor_stats(tree: Any) -> Optional[TreeStats]:
+    """One fused pass over every floating leaf of ``tree``.
+
+    Each leaf contributes four reductions (finite count, abs-max, squared
+    sum, underflow count) that XLA fuses into the producing ops — the same
+    no-extra-memory-pass property :func:`~apex_tpu.amp.scaler.all_finite`
+    relies on. Returns None for a tree with no floating leaves.
+    """
+    pairs = _float_leaves_with_paths(tree)
+    if not pairs:
+        return None
+    paths, sizes = [], []
+    finite, amax, sq, under, half_mask = [], [], [], [], []
+    for path, x in pairs:
+        x = jnp.asarray(x)
+        paths.append(path)
+        sizes.append(int(x.size))
+        x32 = x.astype(jnp.float32)
+        isf = jnp.isfinite(x)
+        # int32 counts: exact per leaf to 2^31 elements (an fp32 count is
+        # exact only to 2^24 — one NaN in an embedding-table-sized leaf
+        # would round away and never be detected)
+        finite.append(jnp.sum(isf, dtype=jnp.int32))
+        amax.append(jnp.max(jnp.abs(x32)) if x.size else jnp.float32(0.0))
+        sq.append(jnp.sum(jnp.where(isf, x32, 0.0) ** 2))
+        is_half = x.dtype in (jnp.float16, jnp.bfloat16)
+        half_mask.append(is_half)
+        if is_half and x.size:
+            tiny = jnp.float32(jnp.finfo(x.dtype).tiny)
+            under.append(jnp.sum(
+                (x32 != 0.0) & (jnp.abs(x32) < tiny), dtype=jnp.int32))
+        else:
+            under.append(jnp.int32(0))
+    return TreeStats(tuple(paths), tuple(sizes), tuple(half_mask),
+                     jnp.stack(finite), jnp.stack(amax), jnp.stack(sq),
+                     jnp.stack(under))
+
+
+# ---------------------------------------------------------------------------
+# attribution side table (trace-time statics -> host decode)
+# ---------------------------------------------------------------------------
+
+# tree name -> leaf paths, written when observe_tree traces. Paths are
+# static per (tree structure, name); the last trace wins, which is correct
+# for the steady-state training loop (one step program per name).
+_LEAF_PATHS: Dict[str, Tuple[str, ...]] = {}
+
+
+def leaf_paths(name: str) -> Optional[Tuple[str, ...]]:
+    """The leaf-path table recorded for tree ``name`` (None if that tree
+    was never observed in this process)."""
+    return _LEAF_PATHS.get(name)
+
+
+_FIRST_LEAF_SUFFIX = "/first_nonfinite_leaf"
+
+
+def decode_attribution(payload: Dict[str, float]) -> Dict[str, str]:
+    """Map every ``health/<tree>/first_nonfinite_leaf`` index in a fetched
+    payload back to the offending leaf's path name.
+
+    Returns ``{tree name: leaf path}`` for trees that flagged (index >= 0);
+    clean trees and unknown names are omitted.
+    """
+    out: Dict[str, str] = {}
+    for key, value in payload.items():
+        if not (key.startswith("health/")
+                and key.endswith(_FIRST_LEAF_SUFFIX)):
+            continue
+        name = key[len("health/"):-len(_FIRST_LEAF_SUFFIX)]
+        paths = _LEAF_PATHS.get(name)
+        idx = int(value)
+        if paths is not None and 0 <= idx < len(paths):
+            out[name] = paths[idx]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# gated recorders (the library's instrumentation points call these)
+# ---------------------------------------------------------------------------
+
+def observe_tree(tree: Any, name: str,
+                 min_level: str = "cheap") -> Optional[TreeStats]:
+    """Record ``health/<name>/*`` for ``tree`` into the step's in-graph
+    metrics — no-op (before touching ``tree``) unless a policy at
+    ``min_level`` or above is active AND a collector is open.
+
+    Recorded scalars (see docs/OBSERVABILITY.md for the mesh reductions):
+    ``nonfinite_count`` (sum of PER-RANK counts — exact for rank-sharded
+    trees, ×replication-factor for replicated observations like
+    post-allreduce DDP grads; exactly 0 iff every rank is clean, which
+    is the alerting contract), ``abs_max`` (max), ``l2`` (mean — the
+    local tree norm, pmean'd; for DDP-synced grads the replicas agree so
+    this is the global norm), ``underflow_frac`` (mean), and
+    ``first_nonfinite_leaf`` (max; -1 = clean, any flagged replica wins).
+
+    Observing the same ``name`` twice in one step (e.g. a GAN step
+    running two ``all_finite`` grad checks, both defaulting to "grads")
+    records the second tree under ``<name>#2``, ``#3``, ... — a last-wins
+    overwrite would sum the counts but drop the first tree's attribution,
+    silently mis-answering "which leaf". Prefer passing distinct names at
+    the call sites; the suffix keeps every check attributable regardless.
+    """
+    if not _live(min_level):
+        return None
+    stats = tensor_stats(tree)
+    if stats is None:
+        return None
+    taken = set(ingraph.recorded_names())
+    candidate, n = name, 1
+    while f"health/{candidate}/first_nonfinite_leaf" in taken:
+        n += 1
+        candidate = f"{name}#{n}"
+    name = candidate
+    _LEAF_PATHS[name] = stats.paths
+    ingraph.record(f"health/{name}/nonfinite_count",
+                   stats.nonfinite_count(), reduce="sum")
+    ingraph.record(f"health/{name}/abs_max",
+                   stats.abs_max_total(), reduce="max")
+    ingraph.record(f"health/{name}/l2", stats.l2(), reduce="mean")
+    ingraph.record(f"health/{name}/underflow_frac",
+                   stats.underflow_fraction(), reduce="mean")
+    ingraph.record(f"health/{name}/first_nonfinite_leaf",
+                   stats.first_nonfinite_index(), reduce="max")
+    return stats
+
+
+def check_replica_agreement(tree: Any,
+                            axis_names: Union[str, Sequence[str]],
+                            name: str = "params"):
+    """Divergence of ``tree`` across the replicas of ``axis_names``:
+    max over leaves of elementwise ``|x - mean_over_replicas(x)|``.
+
+    Values that are replicated *by construction* (DDP params, synced
+    grads, TP-replicated layernorms) read ~0; anything larger is silent
+    replica corruption — a bad collective, a bitflip, a non-deterministic
+    op — that an allreduce would quietly average into every replica.
+    "~0", not exactly 0: the pmean's reduction order can differ from the
+    identity by an ulp, so compiled collectives report O(1e-8·|x|)
+    residue on healthy replicated state — alert on a threshold (e.g.
+    1e-6 × ``health/<name>/abs_max``), not on nonzero. One pmean per
+    leaf, so this is ``level="full"`` instrumentation (or an explicit
+    debugging call). Must run where ``axis_names`` are bound; records
+    ``health/<name>/replica_divergence`` (max) when a collector is open
+    and always returns the f32 scalar.
+    """
+    if isinstance(axis_names, str):
+        axis_names = (axis_names,)
+    axes = tuple(axis_names)
+    from apex_tpu.utils.vma import cast_to_vma
+    devs = []
+    for _, x in _float_leaves_with_paths(tree):
+        x32 = jnp.asarray(x).astype(jnp.float32)
+        if not x32.size:  # zero-size leaf: nothing to diverge on
+            continue
+        mean = jax.lax.pmean(cast_to_vma(x32, frozenset(axes)), axes)
+        devs.append(jnp.max(jnp.abs(x32 - mean)))
+    d = jnp.max(jnp.stack(devs)) if devs else jnp.float32(0.0)
+    ingraph.record(f"health/{name}/replica_divergence", d, reduce="max")
+    return d
+
+
+def observe_replica_agreement(tree: Any,
+                              axis_names: Union[str, Sequence[str]],
+                              name: str = "params"):
+    """Gated :func:`check_replica_agreement`: runs only at
+    ``level="full"`` with a collector open (the pmeans are real
+    collectives — never free)."""
+    if not _live("full"):
+        return None
+    return check_replica_agreement(tree, axis_names, name)
+
+
+# ---------------------------------------------------------------------------
+# host side: crash dumps + the reporter hook
+# ---------------------------------------------------------------------------
+
+def payload_nonfinite(payload: Dict[str, float]) -> bool:
+    """True when a fetched step payload shows non-finite values: any
+    ``health/*/nonfinite_count`` > 0, or the amp scaler counted an
+    overflow this step."""
+    for key, value in payload.items():
+        if key.startswith("health/") and key.endswith("/nonfinite_count"):
+            if value > 0:
+                return True
+    return payload.get("amp/overflow_count", 0.0) > 0.0
+
+
+def _versions() -> Dict[str, str]:
+    out = {"python": platform.python_version(), "jax": jax.__version__}
+    try:
+        import jaxlib
+        out["jaxlib"] = jaxlib.__version__
+    except Exception:
+        pass
+    try:
+        import numpy
+        out["numpy"] = numpy.__version__
+    except Exception:
+        pass
+    try:
+        import apex_tpu
+        out["apex_tpu"] = apex_tpu.__version__
+    except Exception:
+        pass
+    try:
+        out["backend"] = jax.default_backend()
+    except Exception:
+        pass
+    return out
+
+
+@dataclasses.dataclass
+class CrashDump:
+    """Structured record of a numerics failure: everything the post-mortem
+    needs without re-running the job. ``attribution`` maps each flagged
+    tree to the leaf path that went non-finite first
+    (:func:`decode_attribution`); ``metrics`` is the full step payload
+    (in-graph + host registry + timers) the reporter had assembled."""
+
+    step: int
+    metrics: Dict[str, float]
+    attribution: Dict[str, str]
+    config: Dict[str, Any]
+    versions: Dict[str, str]
+    wall_time: float
+
+    @classmethod
+    def from_payload(cls, step: int, payload: Dict[str, float],
+                     config: Optional[HealthConfig] = None) -> "CrashDump":
+        cfg_dict = dataclasses.asdict(config) if config is not None else {}
+        cfg_dict = {k: (os.fspath(v) if isinstance(v, os.PathLike) else v)
+                    for k, v in cfg_dict.items()}
+        return cls(step=int(step), metrics=dict(payload),
+                   attribution=decode_attribution(payload),
+                   config=cfg_dict, versions=_versions(),
+                   wall_time=time.time())
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def write(self, dump_dir: Union[str, os.PathLike] = ".") -> str:
+        """Write ``health_dump_step<N>.json`` into ``dump_dir`` (created
+        if missing); returns the path. Non-finite metric values — which
+        essentially every real dump carries (``abs_max`` = inf on an
+        overflow) — serialize as the STRINGS ``"NaN"``/``"Infinity"``/
+        ``"-Infinity"``, not Python's bare ``Infinity`` literals: the
+        dump exists for post-mortem tooling, and strict parsers (jq,
+        ``JSON.parse``, Go) reject non-standard literals wholesale."""
+        from apex_tpu.observability.sinks import json_safe_metrics
+        dump_dir = os.fspath(dump_dir)
+        os.makedirs(dump_dir, exist_ok=True)
+        path = os.path.join(dump_dir,
+                            f"health_dump_step{self.step:08d}.json")
+        doc = dict(self.to_dict(), metrics=json_safe_metrics(self.metrics))
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True, allow_nan=False)
+        return path
+
+
+class NonFiniteError(RuntimeError):
+    """A reported step carried non-finite values and the active policy
+    said ``on_nonfinite="raise"``. Carries the :class:`CrashDump` (and the
+    path it was written to, when it was)."""
+
+    def __init__(self, message: str, dump: CrashDump,
+                 dump_path: Optional[str] = None):
+        super().__init__(message)
+        self.dump = dump
+        self.dump_path = dump_path
+
+
+class HealthMonitor:
+    """The :class:`~apex_tpu.observability.report.StepReporter` hook
+    enforcing a :class:`HealthConfig`'s ``on_nonfinite`` policy.
+
+    Called once per reported payload (after the sinks emitted, so the
+    telemetry stream always carries the failing step). Keeps the list of
+    written dump paths in ``dumps`` for the caller/tests, and the current
+    non-finite streak in ``streak`` (fires at
+    ``config.consecutive`` — see :class:`HealthConfig`).
+    """
+
+    def __init__(self, config: HealthConfig):
+        self.config = config
+        self.dumps: List[str] = []
+        self.streak = 0
+
+    def __call__(self, step: int, payload: Dict[str, float]) -> None:
+        if self.config.on_nonfinite == "skip":
+            return  # the in-graph select already dropped the update
+        if not payload_nonfinite(payload):
+            self.streak = 0
+            return
+        self.streak += 1
+        if self.streak < self.config.consecutive:
+            return  # could be a routine loss-scale calibration overflow
+        dump = CrashDump.from_payload(step, payload, self.config)
+        path = dump.write(self.config.dump_dir)
+        self.dumps.append(path)
+        if self.config.on_nonfinite == "raise":
+            att = ", ".join(f"{k} -> {v}" for k, v in
+                            dump.attribution.items()) or "unattributed"
+            raise NonFiniteError(
+                f"non-finite values at step {step} ({att}); "
+                f"crash dump: {path}", dump, dump_path=path)

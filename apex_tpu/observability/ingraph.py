@@ -38,8 +38,8 @@ from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-__all__ = ["Metrics", "record", "recording", "reap", "collecting",
-           "aggregate", "REDUCTIONS"]
+__all__ = ["Metrics", "record", "recording", "recorded_names", "reap",
+           "collecting", "aggregate", "REDUCTIONS"]
 
 REDUCTIONS = ("sum", "mean", "max", "min")
 
@@ -129,6 +129,16 @@ def recording() -> bool:
     the code currently being traced/executed. Guard *computations* done
     only for telemetry with this (or pass a thunk to :func:`record`)."""
     return bool(_STATE.stack)
+
+
+def recorded_names() -> Tuple[str, ...]:
+    """The names recorded so far into the innermost open collector
+    (empty when none is open). Lets instrumentation that derives metric
+    names (the health watchdog's per-tree families) detect collisions
+    within one step instead of silently overwriting."""
+    if not _STATE.stack:
+        return ()
+    return tuple(_STATE.stack[-1].values)
 
 
 def record(name: str, value: Union[Any, Callable[[], Any]],

@@ -66,11 +66,18 @@ class Counter(Metric):
 
 
 class Gauge(Metric):
-    """Last-value metric. ``observe``/``set`` overwrite."""
+    """Last-value metric. ``observe``/``set`` overwrite.
+
+    "Never set" is tracked with an explicit flag, NOT a NaN sentinel: the
+    health watchdog legitimately reports NaN-valued gauges (a NaN abs-max
+    IS the signal), and a sentinel would silently swallow them. ``value``
+    still reads NaN when unset, so numeric consumers need no branch; the
+    registry snapshot skips unset gauges via :attr:`is_set`.
+    """
 
     def __init__(self, name: str):
         super().__init__(name)
-        self._value = math.nan
+        self._value: Optional[float] = None
 
     def set(self, value: float) -> None:
         self._value = float(value)
@@ -78,14 +85,18 @@ class Gauge(Metric):
     observe = set
 
     @property
+    def is_set(self) -> bool:
+        return self._value is not None
+
+    @property
     def value(self) -> float:
-        return self._value
+        return math.nan if self._value is None else self._value
 
     def snapshot(self) -> Dict[str, float]:
-        return {self.name: self._value}
+        return {self.name: self.value}
 
     def reset(self) -> None:
-        self._value = math.nan
+        self._value = None
 
 
 class Histogram(Metric):
@@ -182,14 +193,14 @@ class MetricsRegistry:
         return tuple(self._metrics)
 
     def snapshot(self) -> Dict[str, float]:
-        """Flat ``{name: value}`` over every registered metric; NaN gauges
-        (never set) are skipped so sinks don't emit noise."""
+        """Flat ``{name: value}`` over every registered metric; gauges
+        that were never set are skipped so sinks don't emit noise (a gauge
+        explicitly set to NaN IS emitted — see :class:`Gauge`)."""
         out: Dict[str, float] = {}
         for m in self._metrics.values():
-            for k, v in m.snapshot().items():
-                if isinstance(m, Gauge) and math.isnan(v):
-                    continue
-                out[k] = v
+            if isinstance(m, Gauge) and not m.is_set:
+                continue
+            out.update(m.snapshot())
         return out
 
     def reset(self) -> None:

@@ -20,7 +20,8 @@ zero cost; :func:`attach_reporter` swaps the real one in.
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, Optional, Sequence
+import time
+from typing import Callable, Dict, Iterable, Optional, Sequence
 
 from apex_tpu.observability import trace
 from apex_tpu.observability.ingraph import Metrics
@@ -39,21 +40,92 @@ class StepReporter:
     device transfer only when something is emitted). ``capture_spans``
     turns on ``Timer`` span capture for the reporter's lifetime so a
     :class:`~apex_tpu.observability.sinks.ChromeTraceSink` sees them.
+
+    ``hooks`` are host callbacks ``hook(step, payload)`` run after the
+    sinks emit — the attachment point for reactive policies like the
+    numerics watchdog (:meth:`HealthConfig.reporter_hook
+    <apex_tpu.observability.health.HealthConfig.reporter_hook>`); a hook
+    that raises (``on_nonfinite="raise"``) does so *after* the failing
+    step reached every sink. Hooks also run on OFF-interval steps
+    whenever ``metrics`` were passed (with just the in-graph payload —
+    no registry/timer merge, no sink emission): a watchdog that only saw
+    every Nth step would miss the transient non-finite excursion it
+    exists to catch. The per-step metrics fetch this implies is the
+    price of a watchdog; without hooks, off-interval steps stay
+    fetch-free as before.
+
+    :meth:`attach_flops_budget` (or the ``flops_per_step`` ctor arg) turns
+    on a ``perf/mfu`` gauge: model-flops-utilization computed from the
+    wall time between consecutive reports, against
+    :func:`~apex_tpu.observability.costs.peak_flops` by default.
     """
 
     def __init__(self, sinks: Sequence[Sink],
                  registry: Optional[MetricsRegistry] = None,
                  timers=None, interval: int = 1,
-                 capture_spans: bool = False):
+                 capture_spans: bool = False,
+                 hooks: Sequence[Callable[[int, Dict[str, float]], None]]
+                 = (),
+                 flops_per_step: Optional[float] = None,
+                 peak_flops: Optional[float] = None):
         if interval < 1:
             raise ValueError("interval must be >= 1")
         self.sinks = list(sinks)
         self.registry = registry if registry is not None else get_registry()
         self.timers = timers
         self.interval = interval
+        self.hooks = list(hooks)
         self._capture_spans = capture_spans
+        self._flops_per_step: Optional[float] = None
+        self._peak_flops: Optional[float] = None
+        self._last_report: Optional[tuple] = None  # (step, perf_counter)
+        if flops_per_step is not None:
+            self.attach_flops_budget(flops_per_step, peak_flops)
         if capture_spans:
             trace.enable_spans()
+
+    def attach_flops_budget(self, flops_per_step: float,
+                            peak: Optional[float] = None) -> "StepReporter":
+        """Enable the ``perf/mfu`` gauge: ``flops_per_step`` is the
+        per-step model FLOPs (e.g. :func:`~apex_tpu.observability.costs.
+        flops_budget` of the compiled step, or an analytic count);
+        ``peak`` defaults to the first device's
+        :func:`~apex_tpu.observability.costs.peak_flops`. Returns self
+        for chaining."""
+        from apex_tpu.observability.costs import peak_flops as _peak
+        flops = float(flops_per_step)
+        peak = float(peak) if peak is not None else _peak()
+        # fail at configuration time, not as a ZeroDivisionError inside
+        # report() mid-training
+        if flops <= 0.0 or peak <= 0.0:
+            raise ValueError("flops_per_step and peak must be positive, "
+                             f"got {flops} and {peak}")
+        self._flops_per_step = flops
+        self._peak_flops = peak
+        return self
+
+    def _update_mfu(self, step: int) -> None:
+        """Set the perf/mfu gauge from the wall time since the previous
+        report; it reaches the payload through the registry snapshot."""
+        if self._flops_per_step is None:
+            return
+        now = time.perf_counter()
+        prev, self._last_report = self._last_report, (step, now)
+        if prev is None:
+            return
+        d_steps, dt = step - prev[0], now - prev[1]
+        if d_steps <= 0 or dt <= 0.0:
+            return
+        from apex_tpu.observability.costs import mfu
+        self.registry.gauge("perf/mfu").set(
+            mfu(self._flops_per_step * d_steps, dt, self._peak_flops))
+
+    @staticmethod
+    def _metrics_payload(metrics) -> Dict[str, float]:
+        """One device transfer for the step's in-graph metrics."""
+        if isinstance(metrics, Metrics):
+            return metrics.as_floats()
+        return {k: float(v) for k, v in metrics.items()}
 
     def _timer_payload(self, reset: bool) -> Dict[str, float]:
         if self.timers is None:
@@ -75,13 +147,18 @@ class StepReporter:
         the loss you already fetched for logging).
         """
         if step % self.interval:
+            # hooks still inspect every step that carries metrics: a
+            # reactive policy (health watchdog) must not miss a
+            # transient non-finite step just because the sinks sample
+            if self.hooks and metrics is not None:
+                payload = self._metrics_payload(metrics)
+                for hook in self.hooks:
+                    hook(step, payload)
             return None
         payload: Dict[str, float] = {}
         if metrics is not None:
-            if isinstance(metrics, Metrics):
-                payload.update(metrics.as_floats())
-            else:
-                payload.update({k: float(v) for k, v in metrics.items()})
+            payload.update(self._metrics_payload(metrics))
+        self._update_mfu(step)
         payload.update(self.registry.snapshot())
         payload.update(self._timer_payload(reset=reset_timers))
         if extra:
@@ -89,6 +166,10 @@ class StepReporter:
         spans = trace.drain_spans() if trace.spans_enabled() else []
         for sink in self.sinks:
             sink.emit(step, payload, spans)
+        # hooks run AFTER the sinks so a raising policy (e.g. the health
+        # monitor's on_nonfinite="raise") never loses the failing step
+        for hook in self.hooks:
+            hook(step, payload)
         return payload
 
     def close(self) -> None:

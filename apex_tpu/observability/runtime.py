@@ -26,7 +26,8 @@ import jax
 
 from apex_tpu.observability.registry import MetricsRegistry, get_registry
 
-__all__ = ["install_compile_listeners", "sample_memory_stats"]
+__all__ = ["install_compile_listeners", "uninstall_compile_listeners",
+           "reset_compile_listeners", "sample_memory_stats"]
 
 # jax.monitoring event suffixes -> counter names. Matched by suffix so the
 # '/jax/core/compile/...' prefix may move between jax versions without
@@ -36,36 +37,65 @@ _DURATION_COUNTERS = {
     "jaxpr_trace_duration": "jax/traces",
 }
 
-_installed_registries = []
+# ``jax.monitoring`` offers no per-listener deregistration, so exactly ONE
+# process-wide dispatcher is ever registered with jax; it fans out to the
+# currently-installed registries. Installing registers a target (idempotent
+# per registry object), uninstalling removes it — repeated
+# install/uninstall lifecycles (e.g. one per StepReporter session, or per
+# test) can no longer accumulate orphaned listeners that double-count
+# ``jax/compiles`` into a registry forever.
+_TARGETS = []           # [(registry, {suffix: counter}, compile_histogram)]
+_DISPATCHER_ON = False
+
+
+def _dispatch(event: str, duration: float, **kw) -> None:
+    for _reg, counters, compile_s in list(_TARGETS):
+        for suffix, counter in counters.items():
+            if event.endswith(suffix):
+                counter.inc()
+                if suffix == "backend_compile_duration":
+                    compile_s.observe(duration)
 
 
 def install_compile_listeners(
         registry: Optional[MetricsRegistry] = None) -> MetricsRegistry:
-    """Register ``jax.monitoring`` listeners feeding ``registry``.
+    """Feed ``registry`` from ``jax.monitoring`` duration events.
 
-    Idempotent per registry (``jax.monitoring`` offers no per-listener
-    deregistration, so double-installing would double-count). Returns the
-    registry for chaining.
+    Idempotent per registry object — double-installing never
+    double-counts. :func:`uninstall_compile_listeners` undoes it. Returns
+    the registry for chaining.
     """
+    global _DISPATCHER_ON
     reg = registry if registry is not None else get_registry()
-    if any(r is reg for r in _installed_registries):
+    if any(r is reg for r, _, _ in _TARGETS):
         return reg
-    _installed_registries.append(reg)
-
     compile_s = reg.histogram("jax/compile_seconds")
     counters = {suffix: reg.counter(name)
                 for suffix, name in _DURATION_COUNTERS.items()}
-    compiles = counters["backend_compile_duration"]
-
-    def on_duration(event: str, duration: float, **kw) -> None:
-        for suffix, counter in counters.items():
-            if event.endswith(suffix):
-                counter.inc()
-                if counter is compiles:
-                    compile_s.observe(duration)
-
-    jax.monitoring.register_event_duration_secs_listener(on_duration)
+    _TARGETS.append((reg, counters, compile_s))
+    if not _DISPATCHER_ON:
+        jax.monitoring.register_event_duration_secs_listener(_dispatch)
+        _DISPATCHER_ON = True
     return reg
+
+
+def uninstall_compile_listeners(
+        registry: Optional[MetricsRegistry] = None) -> bool:
+    """Stop feeding ``registry`` (default: the process default registry).
+    Returns True when it was installed. The jax-level dispatcher stays
+    registered (jax offers no deregistration) but dispatches to nothing
+    for this registry — its counters keep their values and stop moving."""
+    reg = registry if registry is not None else get_registry()
+    for i, (r, _, _) in enumerate(_TARGETS):
+        if r is reg:
+            del _TARGETS[i]
+            return True
+    return False
+
+
+def reset_compile_listeners() -> None:
+    """Detach every installed registry (for tests)."""
+    del _TARGETS[:]
 
 
 _MEM_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")

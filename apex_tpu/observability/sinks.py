@@ -16,13 +16,31 @@ from __future__ import annotations
 
 import io
 import json
+import math
 import os
 import time
-from typing import Dict, Iterable, Optional, Sequence, Union
+from typing import Any, Dict, Iterable, Optional, Sequence, Union
 
 from apex_tpu.observability.trace import Span, chrome_trace_events
 
-__all__ = ["Sink", "JSONLSink", "TensorBoardSink", "ChromeTraceSink"]
+__all__ = ["Sink", "JSONLSink", "TensorBoardSink", "ChromeTraceSink",
+           "json_safe_value", "json_safe_metrics"]
+
+
+def json_safe_value(value: Any) -> Any:
+    """Non-finite floats as the strings ``"NaN"``/``"Infinity"``/
+    ``"-Infinity"`` — health metrics legitimately carry them (a NaN
+    abs-max IS the signal), and Python's default ``json`` emits bare
+    non-standard literals that jq/``JSON.parse``/Go reject wholesale."""
+    if isinstance(value, float) and not math.isfinite(value):
+        if math.isnan(value):
+            return "NaN"
+        return "Infinity" if value > 0 else "-Infinity"
+    return value
+
+
+def json_safe_metrics(metrics: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: json_safe_value(v) for k, v in metrics.items()}
 
 
 class Sink:
@@ -61,8 +79,9 @@ class JSONLSink(Sink):
     def emit(self, step, metrics, spans=()):
         self._file.write(json.dumps(
             {"step": int(step), "time": time.time(),
-             "metrics": {k: metrics[k] for k in sorted(metrics)}})
-            + "\n")
+             "metrics": {k: json_safe_value(metrics[k])
+                         for k in sorted(metrics)}},
+            allow_nan=False) + "\n")
         self._file.flush()
 
     def close(self):
@@ -123,9 +142,9 @@ class ChromeTraceSink(Sink):
                 self._events.append(
                     {"name": name, "ph": "C", "cat": "apex_tpu",
                      "ts": ts, "pid": self.pid,
-                     "args": {name: metrics[name]}})
+                     "args": {name: json_safe_value(metrics[name])}})
 
     def close(self):
         with open(self.path, "w") as f:
             json.dump({"traceEvents": self._events,
-                       "displayTimeUnit": "ms"}, f)
+                       "displayTimeUnit": "ms"}, f, allow_nan=False)

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.amp.scaler import select_tree
+from apex_tpu.observability import health as _health
 from apex_tpu.observability import ingraph as _metrics
 
 __all__ = ["OptimizerBase", "tree_unzip", "tree_zeros_like_f32",
@@ -83,14 +84,23 @@ class OptimizerBase:
         # telemetry collector is active
         _metrics.record("optim/grad_norm",
                         lambda: global_grad_norm(grads), reduce="mean")
+        # full-level watchdog: the grads as THIS optimizer consumes them
+        # (post-unscale, post-sync — under ZeRO still per-data-rank), named
+        # apart from amp's "grads" so neither record double-counts
+        _health.observe_tree(grads, "optim_grads", min_level="full")
         new_params, new_state = self._step(grads, state, params, **kw)
         if grads_finite is None:
-            return new_params, new_state
-        # Skip = keep old params AND old state (step count does not advance),
-        # exactly like the reference skipping optimizer.step() wholesale.
-        new_params = select_tree(grads_finite, new_params, params)
-        new_state = select_tree(grads_finite, new_state, state)
-        return new_params, new_state
+            new_params_out, new_state_out = new_params, new_state
+        else:
+            # Skip = keep old params AND old state (step count does not
+            # advance), exactly like the reference skipping
+            # optimizer.step() wholesale.
+            new_params_out = select_tree(grads_finite, new_params, params)
+            new_state_out = select_tree(grads_finite, new_state, state)
+        # post-select params: a blowing-up health/params/abs_max curve is
+        # the earliest pre-overflow warning the stream can give
+        _health.observe_tree(new_params_out, "params", min_level="full")
+        return new_params_out, new_state_out
 
     def as_optax(self):
         """Expose as an ``optax.GradientTransformationExtraArgs``; the update

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu.observability import health as _health
 from apex_tpu.observability import ingraph as _metrics
 from apex_tpu.utils.vma import cast_to_vma
 from apex_tpu.utils.compat import axis_size as _axis_size
@@ -145,7 +146,16 @@ def allreduce_grads(grads: Any, axis_name: str = "data",
             g = g * pre
         return g.astype(orig_dtype)
 
-    return jax.tree_util.tree_map(_sync, grads)
+    synced = jax.tree_util.tree_map(_sync, grads)
+    if axis_index_groups is None:
+        # full-level watchdog: post-allreduce grads are replicated by
+        # construction, so any cross-replica divergence here is silent
+        # corruption (bad collective, bitflip, nondeterministic op) — a
+        # trace-time-gated no-op below level="full". Subgroup reduces are
+        # exempt: their results legitimately differ across groups.
+        _health.observe_replica_agreement(synced, axis_name,
+                                          name="ddp_grads")
+    return synced
 
 
 class DistributedDataParallel:

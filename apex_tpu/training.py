@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.config import TrainConfig
+from apex_tpu.observability import health as _health
 from apex_tpu.observability import ingraph
 from apex_tpu.optimizers import AdamState
 from apex_tpu.optimizers.distributed_fused import (_DistributedFusedBase,
@@ -90,9 +91,20 @@ class GPTHybridTrainer:
     config's optimizer over (stage, shared) params.
     """
 
-    def __init__(self, cfg: TrainConfig, mesh, init_scale: float = 2.0 ** 8):
+    def __init__(self, cfg: TrainConfig, mesh, init_scale: float = 2.0 ** 8,
+                 health=None):
+        """``health`` is a
+        :class:`~apex_tpu.observability.health.HealthConfig` (default:
+        the config's ``cfg.build_health()``, itself defaulting to
+        ``level="off"``). With any level above off, the numerics watchdog
+        rides :meth:`train_step_with_metrics` — ``health/*`` metrics (and
+        at ``level="full"`` the data-axis replica-agreement checks) land
+        in the step's Metrics pytree; the uninstrumented
+        :meth:`train_step` and the ``level="off"`` program stay
+        jaxpr-identical to an unconfigured trainer (asserted in tests)."""
         self.cfg = cfg
         self.mesh = mesh
+        self.health = health if health is not None else cfg.build_health()
         self.pp = cfg.parallel.pipeline_model_parallel_size
         self.model = cfg.build_model()
         if (getattr(self.model.cfg, "sequence_parallel", False)
@@ -193,6 +205,11 @@ class GPTHybridTrainer:
         model, opt, scaler, pp = self.model, self.opt, self.scaler, self.pp
 
         def body(stage_stack, shared, opt_state, ls, tokens, targets):
+            # full-level watchdog: params enter the step data-replicated,
+            # so any divergence across the data axis is silent replica
+            # corruption; trace-time-gated no-op below level="full"
+            _health.observe_replica_agreement((stage_stack, shared),
+                                              "data", name="params")
             # rebuild the pipeline closures over THIS dp-rank's targets
             stage, embed_fn, head_fn, _, _ = model.pipeline_fns(pp, targets)
             if getattr(model.cfg, "tp_comm_overlap", False):
@@ -243,8 +260,11 @@ class GPTHybridTrainer:
             def inner(*args):
                 # reap INSIDE shard_map: the recorded scalars live at this
                 # trace level; aggregation over every mesh axis makes them
-                # replicated, so a prefix P() out_spec carries them out
-                out, metrics = ingraph.reap(body)(*args)
+                # replicated, so a prefix P() out_spec carries them out.
+                # The health policy activates around the same trace so the
+                # watchdog's trace-time gates see it.
+                with _health.activate(self.health):
+                    out, metrics = ingraph.reap(body)(*args)
                 return out + (ingraph.aggregate(
                     metrics, tuple(self.mesh.axis_names)),)
         else:

@@ -64,24 +64,12 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
 
 A100_AMP_RN50_IMGS_PER_SEC = 2470.0  # per-chip baseline (see docstring)
 
-# peak dense bf16 TFLOP/s per chip by device kind (public spec sheets)
-_PEAK_BF16 = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,   # v5e
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,        # v5p
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,   # v6e / Trillium
-    "TPU v6e": 918e12,
-}
-
-
-def _peak_flops() -> float:
-    kind = jax.devices()[0].device_kind
-    for k, v in _PEAK_BF16.items():
-        if kind.startswith(k):
-            return v
-    return 197e12  # assume v5e-class if unknown
+# peak-flops table + cost_analysis extraction + MFU math live in
+# observability.costs (shared with StepReporter's perf/mfu gauge) — one
+# source of truth for peak-flops numbers. Imported after the compile-cache
+# config above (import triggers no backend use, but keep the config first).
+from apex_tpu.observability.costs import (  # noqa: E402
+    flops_budget, peak_flops as _peak_flops)
 
 
 def _sync(out) -> None:
@@ -179,14 +167,8 @@ def bench_headline(iters=50, warmup=5):
     # x3 for train) if the backend has no cost analysis. The compiled
     # executable is reused for the timing loop so the program compiles once.
     compiled = step.lower(params, bn_state, opt_state, ls).compile()
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        flops_per_step = float(cost["flops"])
-        if not np.isfinite(flops_per_step) or flops_per_step <= 0:
-            raise KeyError
-    except Exception:
+    flops_per_step = flops_budget(compiled)
+    if flops_per_step is None:
         flops_per_step = 3 * 2 * 4.1e9 * batch
 
     times = _timeit(compiled, (params, bn_state, opt_state, ls),

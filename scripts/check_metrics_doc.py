@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Static check: every in-graph metric recorded in source is documented.
+
+The per-step metric families (``health/*``, ``tp/*``, ``amp/*``,
+``ddp/*``, ``pipeline/*``, ``optim/*``) are a public contract — dashboards
+and the crash-dump post-mortem workflow key on the names — and the
+contract lives in the docs/OBSERVABILITY.md table. A ``record()`` call
+added without a doc row silently grows an undocumented surface; this
+script AST-walks the package for ``record(...)`` call sites, extracts the
+metric-name first argument (plain string literals, and f-strings whose
+formatted fields normalize to a ``<>`` placeholder — ``f"health/{name}/l2"``
+checks as ``health/<>/l2``), and requires each name in a checked family to
+appear in backticks somewhere in the doc (doc placeholders like
+``<tree>`` normalize the same way). No jax import, pre-commit fast; exits
+non-zero listing every undocumented name. Wired into the test suite via
+``tests/test_observability.py::TestCheckMetricsDoc``.
+
+Usage::
+
+    python scripts/check_metrics_doc.py          # check, report, exit 0/1
+    python scripts/check_metrics_doc.py --list   # print recorded names
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = "apex_tpu"
+DOC = os.path.join("docs", "OBSERVABILITY.md")
+
+# metric families under the documentation contract; names outside these
+# prefixes (host registry internals, ad-hoc example metrics) are exempt
+PREFIXES = ("health/", "tp/", "amp/", "ddp/", "pipeline/", "optim/")
+
+_PLACEHOLDER = re.compile(r"<[^<>`]*>")
+
+
+def _norm(name: str) -> str:
+    """Collapse every ``<...>`` placeholder spelling to ``<>`` so the
+    source's ``f"health/{name}/l2"`` matches the doc's
+    ``health/<tree>/l2``."""
+    return _PLACEHOLDER.sub("<>", name)
+
+
+def _literal_name(node) -> str | None:
+    """The metric-name string of a ``record()`` first argument, with
+    f-string fields as ``<>`` — None when it is not statically known."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for piece in node.values:
+            if isinstance(piece, ast.Constant):
+                parts.append(str(piece.value))
+            else:  # FormattedValue
+                parts.append("<>")
+        return "".join(parts)
+    return None
+
+
+def recorded_names(repo: str = REPO):
+    """Yield ``(relpath, lineno, name)`` for every ``record(...)`` metric
+    name in the package that falls under a checked prefix."""
+    pkg_root = os.path.join(repo, PACKAGE)
+    for dirpath, _dirnames, filenames in sorted(os.walk(pkg_root)):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, repo)
+            with open(path) as f:
+                try:
+                    tree = ast.parse(f.read(), filename=rel)
+                except SyntaxError:
+                    continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                func = node.func
+                callee = (func.id if isinstance(func, ast.Name)
+                          else func.attr if isinstance(func, ast.Attribute)
+                          else None)
+                if callee != "record":
+                    continue
+                name = _literal_name(node.args[0])
+                if name is not None and _norm(name).startswith(PREFIXES):
+                    yield rel, node.lineno, name
+
+
+def documented_names(repo: str = REPO) -> set:
+    """Every backticked token in the observability doc, normalized."""
+    with open(os.path.join(repo, DOC)) as f:
+        text = f.read()
+    return {_norm(tok) for tok in re.findall(r"`([^`\n]+)`", text)}
+
+
+def check(repo: str = REPO):
+    """Returns (ok, report_lines)."""
+    try:
+        documented = documented_names(repo)
+    except OSError:
+        return False, [f"MISSING  {DOC}: cannot read the metric table"]
+    lines, ok = [], True
+    for rel, lineno, name in recorded_names(repo):
+        if _norm(name) in documented:
+            lines.append(f"ok       {name} ({rel}:{lineno})")
+        else:
+            ok = False
+            lines.append(f"UNDOC    {name} ({rel}:{lineno}): recorded but "
+                         f"absent from {DOC}")
+    return ok, lines
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--list" in argv:
+        for rel, lineno, name in recorded_names():
+            print(f"{name}\t{rel}:{lineno}")
+        return 0
+    ok, lines = check()
+    for line in lines:
+        print(line)
+    if not ok:
+        print("undocumented metrics found — add rows to the "
+              "docs/OBSERVABILITY.md table (placeholders like <tree> "
+              "match f-string fields) or rename outside the checked "
+              "families in scripts/check_metrics_doc.py", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,6 +1,7 @@
 """Smoke tests: the examples/ scripts (the reference's L5 layer) must run
 end to end on the CPU mesh."""
 
+import json
 import sys
 
 import numpy as np
@@ -67,3 +68,10 @@ def test_telemetry_example_runs(tmp_path):
         assert key in payload
     assert (tmp_path / "telemetry.jsonl").exists()
     assert (tmp_path / "host_trace.json").exists()
+    # the health-watchdog demo ran: the injected inf produced an
+    # attributed crash dump
+    dumps = list(tmp_path.glob("health_dump_step*.json"))
+    assert len(dumps) == 1
+    doc = json.loads(dumps[0].read_text())
+    assert doc["attribution"] == {"grads": "['bad']"}
+    assert doc["metrics"]["health/grads/nonfinite_count"] == 2.0

@@ -57,6 +57,21 @@ class TestRegistry:
         r.reset()
         assert r.snapshot()["c"] == 0.0
 
+    def test_gauge_set_to_nan_is_reported(self):
+        """"Unset" is a flag, not a NaN sentinel: a gauge explicitly set
+        to NaN (a legitimate health value — NaN abs-max IS the signal)
+        must survive into the snapshot."""
+        import math
+
+        r = obs.MetricsRegistry()
+        g = r.gauge("g")
+        assert not g.is_set and math.isnan(g.value)
+        g.set(float("nan"))
+        assert g.is_set
+        assert math.isnan(r.snapshot()["g"])
+        g.reset()
+        assert not g.is_set and "g" not in r.snapshot()
+
     def test_default_registry_singleton(self):
         assert obs.get_registry() is obs.get_registry()
 
@@ -219,6 +234,29 @@ class TestSinks:
         assert line["metrics"] == {"a": 1.0, "b": 2.0}
         assert list(line["metrics"]) == ["a", "b"]  # sorted, grep-stable
 
+    def test_jsonl_nonfinite_values_stay_strict_json(self):
+        """NaN/inf payload values (legitimate health metrics, NaN-set
+        gauges) must serialize as strings, not bare NaN/Infinity
+        literals that strict parsers (jq, JSON.parse, Go) reject."""
+        buf = io.StringIO()
+        obs.JSONLSink(buf).emit(0, {"nan": float("nan"),
+                                    "inf": float("inf"),
+                                    "ninf": float("-inf"), "ok": 1.5})
+        line = json.loads(buf.getvalue(), parse_constant=lambda c:
+                          pytest.fail(f"non-standard literal {c}"))
+        assert line["metrics"] == {"nan": "NaN", "inf": "Infinity",
+                                   "ninf": "-Infinity", "ok": 1.5}
+
+    def test_chrome_counters_nonfinite_safe(self, tmp_path):
+        p = tmp_path / "t.json"
+        sink = obs.ChromeTraceSink(p)
+        sink.emit(0, {"bad": float("inf")})
+        sink.close()
+        doc = json.loads(p.read_text(), parse_constant=lambda c:
+                         pytest.fail(f"non-standard literal {c}"))
+        counter = [e for e in doc["traceEvents"] if e["ph"] == "C"][0]
+        assert counter["args"]["bad"] == "Infinity"
+
     def test_jsonl_appends_to_path(self, tmp_path):
         p = tmp_path / "events.jsonl"
         with obs.JSONLSink(p) as sink:
@@ -319,6 +357,36 @@ class TestStepReporter:
         spans = [e for e in events if e["ph"] == "X"]
         assert [e["name"] for e in spans] == ["step"]
 
+    def test_mfu_gauge_from_flops_budget(self):
+        """With a flops budget attached, consecutive reports carry a
+        perf/mfu gauge computed from the wall time between them."""
+        emitted = []
+
+        class Spy(obs.JSONLSink):
+            def __init__(self):
+                pass
+
+            def emit(self, step, metrics, spans=()):
+                emitted.append(dict(metrics))
+
+            def close(self):
+                pass
+
+        rep = obs.StepReporter([Spy()], registry=obs.MetricsRegistry())
+        with pytest.raises(ValueError):
+            rep.attach_flops_budget(1e6, peak=0.0)  # fail at config time
+        with pytest.raises(ValueError):
+            rep.attach_flops_budget(-1.0)
+        assert rep.attach_flops_budget(1e6, peak=1e9) is rep
+        rep.report(0)
+        assert "perf/mfu" not in emitted[0]  # no prior report to diff
+        time.sleep(0.005)
+        rep.report(2)
+        # 2 steps x 1e6 flops over >= 5ms against a 1e9 peak
+        assert 0.0 < emitted[1]["perf/mfu"] <= 2e6 / 0.005 / 1e9
+        # the gauge also lands in the registry for later snapshots
+        assert rep.registry.snapshot()["perf/mfu"] == emitted[1]["perf/mfu"]
+
     def test_null_reporter_default(self):
         obs.detach_reporter()
         rep = obs.get_reporter()
@@ -331,6 +399,108 @@ class TestStepReporter:
         finally:
             obs.detach_reporter()
         assert not obs.get_reporter()
+
+
+# ---------------------------------------------------------------------------
+# trace span buffer under concurrency
+# ---------------------------------------------------------------------------
+
+class TestTraceConcurrency:
+    def test_concurrent_record_and_drain_loses_nothing(self):
+        """Producer threads hammer record_span while a drainer races
+        drain_spans: every span must come out exactly once (the _SPANS
+        buffer swap is lock-protected on both sides)."""
+        import threading
+
+        from apex_tpu.observability import trace
+
+        n_producers, n_spans = 4, 300
+        drained = []
+        stop = threading.Event()
+
+        def produce(k):
+            for i in range(n_spans):
+                trace.record_span(f"p{k}-{i}", float(i), float(i) + 1.0)
+
+        def drain():
+            while not stop.is_set():
+                drained.extend(trace.drain_spans())
+
+        trace.enable_spans()
+        try:
+            threads = [threading.Thread(target=produce, args=(k,))
+                       for k in range(n_producers)]
+            drainer = threading.Thread(target=drain)
+            drainer.start()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            stop.set()
+            drainer.join()
+            drained.extend(trace.drain_spans())
+        finally:
+            trace.disable_spans()
+        names = [s.name for s in drained]
+        assert len(names) == n_producers * n_spans
+        assert len(set(names)) == len(names)  # no duplicates either
+
+    def test_disable_drops_undrained_spans(self):
+        from apex_tpu.observability import trace
+
+        trace.enable_spans()
+        trace.record_span("stale", 0.0, 1.0)
+        trace.disable_spans()
+        trace.enable_spans()
+        try:
+            assert trace.drain_spans() == []
+        finally:
+            trace.disable_spans()
+
+
+# ---------------------------------------------------------------------------
+# costs: peak-flops table + MFU math (shared with bench.py)
+# ---------------------------------------------------------------------------
+
+class TestCosts:
+    def test_peak_flops_table_and_fallback(self):
+        class Fake:
+            def __init__(self, kind):
+                self.device_kind = kind
+
+        assert obs.peak_flops(Fake("TPU v4 something")) == 275e12
+        assert obs.peak_flops(Fake("TPU v5e")) == 197e12
+        from apex_tpu.observability.costs import DEFAULT_PEAK_FLOPS
+        assert obs.peak_flops(Fake("cpu")) == DEFAULT_PEAK_FLOPS
+        assert obs.peak_flops() == DEFAULT_PEAK_FLOPS  # CPU test host
+
+    def test_flops_budget_from_compiled(self):
+        compiled = jax.jit(lambda x: x @ x).lower(
+            jnp.ones((8, 8))).compile()
+        budget = obs.flops_budget(compiled)
+        # the CPU backend reports a real flop count for a matmul; a
+        # backend without cost analysis must yield None, not raise
+        assert budget is None or budget > 0
+        assert obs.flops_budget(object()) is None
+
+    def test_mfu_math(self):
+        assert obs.mfu(10.0, 2.0, peak=1.0) == 5.0
+        with pytest.raises(ValueError):
+            obs.mfu(1.0, 0.0, peak=1.0)
+
+    def test_bench_imports_from_costs(self):
+        """bench.py must not regrow its own table — one source of truth."""
+        import ast
+        src = ast.parse(open("bench.py").read())
+        assigned = {t.id for node in ast.walk(src)
+                    if isinstance(node, ast.Assign)
+                    for t in node.targets if isinstance(t, ast.Name)}
+        assert "_PEAK_BF16" not in assigned
+        imports = [n for node in ast.walk(src)
+                   if isinstance(node, ast.ImportFrom)
+                   and node.module == "apex_tpu.observability.costs"
+                   for n in node.names]
+        assert {a.name for a in imports} >= {"flops_budget", "peak_flops"}
 
 
 # ---------------------------------------------------------------------------
@@ -350,6 +520,38 @@ class TestRuntime:
         assert reg.counter("jax/traces").value >= after
         snap = reg.snapshot()
         assert snap["jax/compile_seconds_count"] == after
+
+    def test_uninstall_and_reinstall(self):
+        """Listener lifecycles are reversible: an uninstalled registry's
+        counters stop moving, a reinstalled one counts again — repeated
+        StepReporter-style lifecycles cannot double-count."""
+        def fresh_compile():
+            salt = np.random.default_rng().integers(1 << 30)
+            jax.jit(lambda x: x + float(salt))(
+                jnp.ones(3)).block_until_ready()
+
+        reg = obs.MetricsRegistry()
+        obs.install_compile_listeners(reg)
+        fresh_compile()
+        counted = reg.counter("jax/compiles").value
+        assert counted >= 1
+        assert obs.uninstall_compile_listeners(reg)
+        assert not obs.uninstall_compile_listeners(reg)  # already gone
+        fresh_compile()
+        assert reg.counter("jax/compiles").value == counted  # frozen
+        obs.install_compile_listeners(reg)
+        fresh_compile()
+        assert reg.counter("jax/compiles").value == counted + 1
+
+    def test_reset_detaches_everything(self):
+        regs = [obs.MetricsRegistry(), obs.MetricsRegistry()]
+        for r in regs:
+            obs.install_compile_listeners(r)
+        obs.reset_compile_listeners()
+        salt = np.random.default_rng().integers(1 << 30)
+        jax.jit(lambda x: x - float(salt))(jnp.ones(3)).block_until_ready()
+        for r in regs:
+            assert r.counter("jax/compiles").value == 0
 
     def test_memory_stats_sampler(self):
         reg = obs.MetricsRegistry()
@@ -670,3 +872,59 @@ class TestCheckCollectives:
         # the real tree stays clean (wrapper modules allowlisted)
         ok, lines = mod.check()
         assert ok, "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# metric-name documentation contract (no undocumented health/tp/amp/...)
+# ---------------------------------------------------------------------------
+
+class TestCheckMetricsDoc:
+    def test_script_passes_on_this_tree(self):
+        proc = subprocess.run(
+            [sys.executable, "scripts/check_metrics_doc.py"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        # the known families all show up as checked
+        for family in ("health/", "amp/", "ddp/", "pipeline/", "optim/",
+                       "tp/"):
+            assert family in proc.stdout, family
+
+    def _mod(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "check_metrics_doc", "scripts/check_metrics_doc.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_detects_undocumented_metric(self, tmp_path):
+        mod = self._mod()
+        pkg = tmp_path / "apex_tpu"
+        pkg.mkdir()
+        (pkg / "m.py").write_text(
+            "from apex_tpu.observability import ingraph\n"
+            "def f(x, name):\n"
+            "    ingraph.record('health/rogue_metric', x)\n"
+            "    ingraph.record(f'health/{name}/rogue_family', x)\n")
+        docs = tmp_path / "docs"
+        docs.mkdir()
+        (docs / "OBSERVABILITY.md").write_text("| nothing documented |\n")
+        ok, lines = mod.check(repo=str(tmp_path))
+        assert not ok
+        undoc = [l for l in lines if l.startswith("UNDOC")]
+        assert len(undoc) == 2
+        assert any("health/rogue_metric" in l for l in undoc)
+        # the f-string field normalized to a placeholder
+        assert any("health/<>/rogue_family" in l for l in undoc)
+        # documenting both (any placeholder spelling) makes it pass
+        (docs / "OBSERVABILITY.md").write_text(
+            "| `health/rogue_metric` | sum | x |\n"
+            "| `health/<tree>/rogue_family` | max | y |\n")
+        ok, lines = mod.check(repo=str(tmp_path))
+        assert ok, "\n".join(lines)
+
+    def test_missing_doc_fails(self, tmp_path):
+        mod = self._mod()
+        (tmp_path / "apex_tpu").mkdir()
+        ok, lines = mod.check(repo=str(tmp_path))
+        assert not ok and any("MISSING" in l for l in lines)
