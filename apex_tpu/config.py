@@ -28,6 +28,19 @@ def _asdict(obj) -> dict:
     return dataclasses.asdict(obj)
 
 
+def _zero_enabled(v) -> bool:
+    """Normalize ``OptimizerConfig.zero``: accepts the legacy bool plus the
+    stage spelling (``"off" | 1 | "1"``) — ZeRO stage 1 (sharded optimizer
+    state) is the only stage this library implements, so anything truthy
+    beyond stage 1 is rejected loudly."""
+    if v in (False, 0, None) or v == "off":
+        return False
+    if v in (True, 1) or v == "1":
+        return True
+    raise ValueError(
+        f"unsupported zero={v!r}; expected off|1 (bools accepted)")
+
+
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:
     """Network-size args (``arguments.py`` ``_add_network_size_args``)."""
@@ -80,7 +93,11 @@ class OptimizerConfig:
     eps: float = 1e-8
     momentum: float = 0.9             # sgd
     flat: bool = False                # wrap in FlatOptimizer
-    zero: bool = False                # DistributedFused* over the data axis
+    # ZeRO stage over the data axis: off | 1 (bools accepted) selects
+    # DistributedFusedAdam/LAMB — optimizer state sharded 1/dp, grads
+    # reduce-scattered, updated params all-gathered (per-bucket when
+    # TrainConfig.ddp_bucket_bytes is set)
+    zero: Any = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -103,6 +120,12 @@ class TrainConfig:
     health_on_nonfinite: str = "skip"  # raise | dump | skip
     health_consecutive: int = 1
     health_dump_dir: str = "."
+    # DP gradient-sync bucketing (parallel/distributed.py bucketing
+    # engine): bytes per flat fp32 bucket for the DDP allreduce and the
+    # ZeRO reduce-scatter/all-gather. None = disabled — the trainer step
+    # is provably identical to the pre-bucketing program (asserted on the
+    # jaxpr, the same contract as health level="off").
+    ddp_bucket_bytes: Optional[int] = None
 
     # -- serialization ----------------------------------------------------
     def to_dict(self) -> dict:
@@ -187,16 +210,18 @@ class TrainConfig:
         from apex_tpu import optimizers as opt
 
         o = self.optimizer
-        if o.zero:
+        if _zero_enabled(o.zero):
             if o.name in ("adam", "adamw"):
                 return opt.DistributedFusedAdam(
                     lr=o.lr, betas=o.betas, eps=o.eps,
                     adam_w_mode=o.name == "adamw",
-                    weight_decay=o.weight_decay)
+                    weight_decay=o.weight_decay,
+                    bucket_bytes=self.ddp_bucket_bytes)
             if o.name == "lamb":
                 return opt.DistributedFusedLAMB(
                     lr=o.lr, betas=o.betas, eps=o.eps,
-                    weight_decay=o.weight_decay)
+                    weight_decay=o.weight_decay,
+                    bucket_bytes=self.ddp_bucket_bytes)
             raise ValueError(f"no ZeRO variant of {o.name!r}")
         if o.name in ("adam", "adamw"):
             inner = opt.FusedAdam(lr=o.lr, betas=o.betas, eps=o.eps,

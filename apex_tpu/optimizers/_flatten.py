@@ -20,7 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["FlatLayout", "build_layout", "ravel", "unravel", "segment_ids"]
+__all__ = ["FlatLayout", "build_layout", "ravel", "unravel", "segment_ids",
+           "bucket_bounds"]
 
 
 class FlatLayout(NamedTuple):
@@ -67,6 +68,35 @@ def unravel(flat: jnp.ndarray, lay: FlatLayout) -> Any:
         leaves.append(jax.lax.dynamic_slice_in_dim(flat, off, size)
                       .reshape(shape).astype(dtype))
     return jax.tree_util.tree_unflatten(lay.treedef, leaves)
+
+
+def bucket_bounds(lay: FlatLayout,
+                  bucket_bytes: "int | None") -> Tuple[Tuple[int, int], ...]:
+    """Static ``(offset, size)`` spans carving the padded flat vector into
+    fixed-size buckets of ~``bucket_bytes`` fp32 elements — the
+    torch-DDP-style bucketing grid shared by the bucketed DDP allreduce and
+    the ZeRO per-bucket reduce-scatter/all-gather
+    (:mod:`apex_tpu.parallel.distributed`).
+
+    Every span's size is a multiple of ``lay.padded // lay.chunk`` (the
+    shard count the layout was built for), so each bucket reduce-scatters
+    cleanly over that axis. ``bucket_bytes=None`` means no bucketing: one
+    span covering the whole vector (the monolithic path).
+    """
+    if bucket_bytes is None:
+        return ((0, lay.padded),)
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes must be positive, got {bucket_bytes}")
+    chunks = lay.padded // lay.chunk if lay.chunk else 1
+    per = max(1, int(bucket_bytes) // 4)          # fp32 elements per bucket
+    per = ((per + chunks - 1) // chunks) * chunks  # divisible by shard count
+    bounds = []
+    off = 0
+    while off < lay.padded:
+        n = min(per, lay.padded - off)  # tail stays divisible: padded%chunks==0
+        bounds.append((off, n))
+        off += n
+    return tuple(bounds) or ((0, 0),)
 
 
 def segment_ids(lay: FlatLayout) -> jnp.ndarray:

@@ -17,6 +17,25 @@ after, trading overflow headroom in half precision),
 
 Use inside ``shard_map``/``pmap`` with a named axis, or under jit with
 sharding constraints where XLA inserts the psum itself.
+
+**Bucketing** (``bucket_bytes=...``): one psum per grad leaf is the right
+default for a handful of large tensors, but a transformer's ~10²–10³ leaves
+become that many small latency-bound collectives, while one monolithic
+flat psum serializes the whole window behind a single full-tree transfer.
+The bucketed path is the reference's bucketed allreduce
+(``distributed.py:319-556``; Li et al., VLDB 2021) restated for XLA: grads
+are raveled into one flat fp32 vector (the
+:mod:`apex_tpu.optimizers._flatten` layout) and reduced in B fixed-size
+buckets — B *independent* collectives whose transfers XLA's latency-hiding
+scheduler can overlap with each other's scale/unravel epilogues and with
+any step work that doesn't consume the synced grads (the loss-scale
+update, the local finite-check). The ZeRO optimizers
+(:mod:`apex_tpu.optimizers.distributed_fused`) reduce-scatter and
+all-gather over the same bucket grid, so bucket k's gather rides under
+bucket k+1's update math. This module is also the package's raw
+``lax.psum_scatter`` chokepoint (:func:`reduce_scatter_grads`) —
+``scripts/check_collectives.py`` flags grad-sync collectives anywhere
+else, so future code cannot bypass the bucketing engine.
 """
 
 from __future__ import annotations
@@ -33,7 +52,29 @@ from apex_tpu.utils.vma import cast_to_vma
 from apex_tpu.utils.compat import axis_size as _axis_size
 
 __all__ = ["allreduce_grads", "DistributedDataParallel", "Reducer",
-           "grouped_psum"]
+           "grouped_psum", "reduce_scatter_grads", "DEFAULT_BUCKET_BYTES"]
+
+# ~4 MiB per bucket: large enough that per-collective latency amortizes,
+# small enough that several buckets are in flight per window (torch-DDP's
+# default is 25 MB for NCCL ring allreduce; ICI latencies are lower, so a
+# smaller default keeps more overlap opportunity — see docs/PERF.md
+# "DP overlap + ZeRO" for the sizing methodology)
+DEFAULT_BUCKET_BYTES = 4 << 20
+
+
+def reduce_scatter_grads(flat: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Tiled fp32 reduce-scatter of a flat grad (bucket) over ``axis_name``
+    — each rank receives the *summed* ``1/axis_size`` slice it owns. The
+    package's single raw ``lax.psum_scatter`` grad-sync site: ZeRO's
+    :meth:`~apex_tpu.optimizers.distributed_fused._DistributedFusedBase.
+    _shard_grads` routes here per bucket (``reference:apex/contrib/
+    optimizers/distributed_fused_adam.py:409``), and
+    ``scripts/check_collectives.py`` flags raw ``psum_scatter`` call sites
+    anywhere outside this module (sequence-dim *activation* scatters are
+    separately allowlisted there)."""
+    return jax.lax.psum_scatter(
+        cast_to_vma(flat, frozenset({axis_name})), axis_name,
+        scatter_dimension=0, tiled=True)
 
 
 def grouped_psum(x: jnp.ndarray, axis_name: str,
@@ -101,11 +142,60 @@ def _group_size_for_rank(axis_name: str, groups) -> jnp.ndarray:
     return jnp.asarray(sizes)[jax.lax.axis_index(axis_name)]
 
 
+def _bucketed_allreduce(grads: Any, axis_name: str,
+                        gradient_predivide_factor: float,
+                        gradient_average: bool, bucket_bytes: int) -> Any:
+    """The bucketing engine: ravel the grad tree into one flat fp32 vector,
+    psum it in B fixed-size buckets (independent collectives XLA can
+    overlap), scale per bucket, unravel. Always reduces in fp32 — the
+    ravel *is* the fp32 master-grad copy, so ``allreduce_always_fp32``
+    is implied on this path (same numeric contract as the ZeRO
+    reduce-scatter)."""
+    from apex_tpu.optimizers._flatten import (bucket_bounds, build_layout,
+                                              ravel, unravel)
+    lay = build_layout(grads, chunks=1)
+    bounds = bucket_bounds(lay, bucket_bytes)
+    world = _axis_size(axis_name)
+    pre = gradient_predivide_factor
+
+    if _metrics.recording():
+        _metrics.record("ddp/allreduce_bytes", float(4 * lay.total),
+                        reduce="sum")
+        _metrics.record("ddp/num_buckets", float(len(bounds)), reduce="mean")
+        _metrics.record("ddp/bucket_bytes",
+                        float(4 * max(n for _, n in bounds)), reduce="mean")
+
+    if gradient_average:
+        post = pre / world
+    else:
+        post = pre if pre != 1.0 else None
+
+    with jax.named_scope("apex_ddp_bucketed_allreduce"):
+        flat = ravel(grads, lay)
+        if pre != 1.0:
+            flat = flat / pre
+        pieces = []
+        for off, n in bounds:
+            # one psum per bucket; the post-scale is per-bucket epilogue
+            # work the scheduler can run under the next bucket's transfer
+            b = jax.lax.psum(
+                cast_to_vma(jax.lax.slice_in_dim(flat, off, off + n),
+                            frozenset({axis_name})), axis_name)
+            if post is not None:
+                b = b * post
+            pieces.append(b)
+        flat = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+    synced = unravel(flat, lay)
+    _health.observe_replica_agreement(synced, axis_name, name="ddp_grads")
+    return synced
+
+
 def allreduce_grads(grads: Any, axis_name: str = "data",
                     gradient_predivide_factor: float = 1.0,
                     allreduce_always_fp32: bool = False,
                     gradient_average: bool = True,
-                    axis_index_groups: Optional[Sequence[Sequence[int]]] = None
+                    axis_index_groups: Optional[Sequence[Sequence[int]]] = None,
+                    bucket_bytes: Optional[int] = None
                     ) -> Any:
     """psum a grad pytree over ``axis_name`` with apex DDP's numeric options.
 
@@ -113,7 +203,23 @@ def allreduce_grads(grads: Any, axis_name: str = "data",
     (``shard_map``, ``pmap``, ...). ``axis_index_groups`` restricts the
     reduction to subgroups — the analog of passing a ``process_group``
     (``reference:apex/parallel/__init__.py:58+``).
+
+    ``bucket_bytes`` switches to the bucketed engine (module docstring):
+    the tree is reduced as B flat fp32 buckets instead of one psum per
+    leaf — identical numerics to ``allreduce_always_fp32=True`` up to the
+    reduction's reassociation, with B independent collectives for XLA's
+    scheduler to overlap. ``None`` (default) keeps the per-leaf path
+    byte-identical to the pre-bucketing library. Incompatible with
+    ``axis_index_groups`` (subgroup reduces stay per-leaf).
     """
+    if bucket_bytes is not None:
+        if axis_index_groups is not None:
+            raise ValueError(
+                "bucket_bytes and axis_index_groups are mutually exclusive: "
+                "the bucketed engine reduces over the full axis")
+        return _bucketed_allreduce(grads, axis_name,
+                                   gradient_predivide_factor,
+                                   gradient_average, bucket_bytes)
     if axis_index_groups is not None:
         world = _group_size_for_rank(axis_name, axis_index_groups)
     else:
@@ -162,10 +268,13 @@ class DistributedDataParallel:
     """Functional DDP: holds the sync policy, applies it to grad trees.
 
     The ctor keeps the reference's argument names (``distributed.py:162-175``)
-    where they still mean something; bucket/stream arguments
-    (``message_size``, ``num_allreduce_streams``, ...) are accepted and
-    ignored — bucketing and overlap are XLA's scheduler's concern, which is
-    the design point of this port.
+    where they still mean something; stream arguments
+    (``num_allreduce_streams``, ...) are accepted and ignored — stream
+    scheduling is XLA's concern. Bucketing, however, is *real* again:
+    ``bucket_bytes`` (the role of the reference's ``message_size``,
+    ``distributed.py:165``, restated in bytes) routes :meth:`sync_gradients`
+    through the bucketed flat-fp32 engine (module docstring) so the window's
+    sync is B overlappable collectives instead of one psum per leaf.
 
     ``delay_allreduce=True`` is real (torch-DDP ``no_sync`` semantics, the
     closest analog of the reference flag at ``distributed.py:162``):
@@ -183,19 +292,27 @@ class DistributedDataParallel:
                  gradient_average: bool = True,
                  axis_index_groups: Optional[Sequence[Sequence[int]]] = None,
                  delay_allreduce: bool = False,
-                 **_ignored_bucketing_args):
+                 bucket_bytes: Optional[int] = None,
+                 **_ignored_stream_args):
+        if axis_index_groups is not None and bucket_bytes is not None:
+            # same contract as allreduce_grads/Reducer, failed at the
+            # misconfiguration site instead of deep inside a later trace
+            raise ValueError(
+                "bucket_bytes and axis_index_groups are mutually exclusive: "
+                "the bucketed engine reduces over the full axis")
         self.axis_name = axis_name
         self.gradient_predivide_factor = gradient_predivide_factor
         self.allreduce_always_fp32 = allreduce_always_fp32
         self.gradient_average = gradient_average
         self.axis_index_groups = axis_index_groups
         self.delay_allreduce = delay_allreduce
+        self.bucket_bytes = bucket_bytes
 
     def sync_gradients(self, grads: Any) -> Any:
         return allreduce_grads(
             grads, self.axis_name, self.gradient_predivide_factor,
             self.allreduce_always_fp32, self.gradient_average,
-            self.axis_index_groups)
+            self.axis_index_groups, bucket_bytes=self.bucket_bytes)
 
     def value_and_grad(self, loss_fn, **vag_kwargs):
         """``jax.value_and_grad`` whose grads come back already synced —
@@ -227,12 +344,21 @@ class DistributedDataParallel:
 class Reducer:
     """Manual full-reduction helper (``reference:apex/parallel/distributed.py:89-126``):
     no hooks, user calls ``reduce`` explicitly on params or grads; values are
-    allreduce-averaged."""
+    allreduce-averaged. ``bucket_bytes`` runs the mean through the bucketed
+    flat-fp32 engine (B overlappable psums) instead of one pmean per leaf —
+    mutually exclusive with ``axis_index_groups`` (the ctor raises, same
+    contract as :func:`allreduce_grads`)."""
 
     def __init__(self, axis_name: str = "data",
-                 axis_index_groups: Optional[Sequence[Sequence[int]]] = None):
+                 axis_index_groups: Optional[Sequence[Sequence[int]]] = None,
+                 bucket_bytes: Optional[int] = None):
+        if axis_index_groups is not None and bucket_bytes is not None:
+            raise ValueError(
+                "bucket_bytes and axis_index_groups are mutually exclusive: "
+                "the bucketed engine reduces over the full axis")
         self.axis_name = axis_name
         self.axis_index_groups = axis_index_groups
+        self.bucket_bytes = bucket_bytes
 
     def reduce(self, tree: Any) -> Any:
         if self.axis_index_groups is not None:
@@ -242,5 +368,8 @@ class Reducer:
                 lambda x: grouped_psum(x, self.axis_name,
                                        self.axis_index_groups) / world,
                 tree)
+        if self.bucket_bytes is not None:
+            return _bucketed_allreduce(tree, self.axis_name, 1.0, True,
+                                       self.bucket_bytes)
         return jax.tree_util.tree_map(
             lambda x: jax.lax.pmean(x, self.axis_name), tree)

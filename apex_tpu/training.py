@@ -25,7 +25,8 @@ from apex_tpu.parallel.distributed import allreduce_grads
 from apex_tpu.transformer.amp import GradScaler
 from apex_tpu.transformer.pipeline_parallel import (
     forward_backward_pipelining_without_interleaving)
-from apex_tpu.utils.compat import HAS_VMA, shard_map_unchecked
+from apex_tpu.utils.compat import (HAS_VMA, shard_map_unchecked,
+                                   axis_size as _compat_axis_size)
 from apex_tpu.utils.vma import cast_to_vma, scan_stable_vma
 
 __all__ = ["GPTHybridTrainer", "accumulate_gradients"]
@@ -47,12 +48,16 @@ def accumulate_gradients(ddp, loss_fn, params, microbatches):
     cutting DP traffic by K× at identical numerics (grad of the mean loss
     over the window, then DDP's numeric policy).
 
-    Must run where ``ddp.axis_name`` is bound. Returns ``(mean_loss,
-    synced_grads)``; the loss is this replica's local window mean (pmean it
-    over the data axis if a replicated value is needed).
+    Must run where ``ddp.axis_name`` is bound (validated at trace time —
+    an unbound axis or an empty window, ``num_micro == 0``, raises
+    ``ValueError`` instead of tracing a silently-NaN program). With a
+    bucketed ``ddp`` (``DistributedDataParallel(bucket_bytes=...)``) the
+    window sync fires as B flat fp32 buckets in the scan epilogue — B
+    independent collectives XLA can overlap with epilogue work that does
+    not consume the synced grads. Returns ``(mean_loss, synced_grads)``;
+    the loss is this replica's local window mean (pmean it over the data
+    axis if a replicated value is needed).
     """
-    params_v = jax.tree_util.tree_map(
-        lambda p: cast_to_vma(p, frozenset({ddp.axis_name})), params)
     leading = {jnp.shape(l)[0]
                for l in jax.tree_util.tree_leaves(microbatches)}
     if len(leading) != 1:
@@ -60,6 +65,23 @@ def accumulate_gradients(ddp, loss_fn, params, microbatches):
             f"microbatch leaves disagree on the accumulation axis: "
             f"{sorted(leading)}")
     num_micro = leading.pop()
+    if num_micro == 0:
+        # without this the scan produces all-zero grads and the 0/0 window
+        # mean is a silent NaN loss — fail loudly at trace time instead
+        raise ValueError(
+            "accumulate_gradients got an empty accumulation window "
+            "(num_micro == 0); every microbatch leaf has leading dim 0")
+    try:
+        _compat_axis_size(ddp.axis_name)
+    except Exception as e:
+        # axis_size raises (NameError on most jax lines) when the name is
+        # unbound; surface a trace-placement error, not a deep psum failure
+        raise ValueError(
+            f"accumulate_gradients must be traced where ddp.axis_name="
+            f"{ddp.axis_name!r} is bound (inside shard_map/pmap over that "
+            f"mesh axis); it is not bound here") from e
+    params_v = jax.tree_util.tree_map(
+        lambda p: cast_to_vma(p, frozenset({ddp.axis_name})), params)
 
     def body(carry, mb):
         acc, loss_sum = carry
@@ -105,6 +127,9 @@ class GPTHybridTrainer:
         self.cfg = cfg
         self.mesh = mesh
         self.health = health if health is not None else cfg.build_health()
+        # DP-sync bucketing (None = per-leaf psums / monolithic ZeRO
+        # collectives, provably identical to the pre-bucketing trainer)
+        self.bucket_bytes = cfg.ddp_bucket_bytes
         self.pp = cfg.parallel.pipeline_model_parallel_size
         self.model = cfg.build_model()
         if (getattr(self.model.cfg, "sequence_parallel", False)
@@ -159,7 +184,7 @@ class GPTHybridTrainer:
         # tensor slice x its 1/dp chunk): fully sharded along dim 0
         flat = P(("pipe", "data", "tensor"))
         return ZeroAdamState(step=P(), master=flat, exp_avg=flat,
-                             exp_avg_sq=flat)
+                             exp_avg_sq=flat, bucket_stamp=P())
 
     # -- shardings --------------------------------------------------------
     @staticmethod
@@ -185,6 +210,51 @@ class GPTHybridTrainer:
                    targets):
         return self._step_impl(False, stage_stack, shared, opt_state, ls,
                                tokens, targets)
+
+    def jit_train_step(self, with_metrics: bool = False,
+                       donate: bool = True):
+        """``jax.jit`` of :meth:`train_step` (or
+        :meth:`train_step_with_metrics`) with ``stage_stack``/``shared``/
+        ``opt_state`` donated (``donate_argnums=(0, 1, 2)``): the step
+        consumes each and returns its successor, so donation lets XLA
+        update parameters and optimizer state in place instead of holding
+        both generations live — the per-step HBM high-water drops by about
+        a full parameter+optimizer copy (asserted on the compiled
+        ``input_output_alias`` in tests). Callers must treat the passed
+        state as consumed (standard donated-jit contract); pass
+        ``donate=False`` to keep the old copy valid.
+
+        On the ZeRO path the returned callable also validates the
+        optimizer state's bucket-grid stamp on its FIRST dispatch — a
+        checkpoint trained under a different ``ddp_bucket_bytes`` enters
+        the step exactly there, and its bucket-major shard order would
+        otherwise be silently permuted (see
+        :meth:`~apex_tpu.optimizers.distributed_fused.
+        _DistributedFusedBase.check_state`). First-call-only on purpose:
+        reading the stamp forces a host sync, and every later state is
+        this step's own output with the stamp threaded through unchanged
+        — a per-step check would serialize the async dispatch pipeline
+        for a constant. The ``.lower`` AOT surface is the raw jit's and
+        does NOT validate — AOT callers restoring checkpoints must call
+        ``trainer.opt.check_state(opt_state)`` themselves.
+        """
+        fn = (self.train_step_with_metrics if with_metrics
+              else self.train_step)
+        jitted = jax.jit(fn, donate_argnums=(0, 1, 2) if donate else ())
+        if not self.is_zero:
+            return jitted
+        opt = self.opt
+        pending = [True]
+
+        def checked(stage_stack, shared, opt_state, ls, tokens, targets):
+            if pending:
+                opt.check_state(opt_state)
+                pending.clear()
+            return jitted(stage_stack, shared, opt_state, ls, tokens,
+                          targets)
+
+        checked.lower = jitted.lower  # raw AOT surface (no stamp check)
+        return checked
 
     def train_step_with_metrics(self, stage_stack, shared, opt_state, ls,
                                 tokens, targets):
@@ -235,8 +305,6 @@ class GPTHybridTrainer:
                     shared_params=vary(shared), embed_fn=embed_fn,
                     grad_scale=ls.loss_scale)
             grads = (jax.tree_util.tree_map(lambda g: g[None], sg), shg)
-            if not self.is_zero:
-                grads = allreduce_grads(grads, "data")
             # (ZeRO: the optimizer's psum_scatter/dp IS the DDP mean —
             # reduce_scatter replaces the allreduce, the ZeRO comm win)
             if self.is_zero:
@@ -247,7 +315,23 @@ class GPTHybridTrainer:
                 from apex_tpu.amp.scaler import all_finite
                 finite = all_finite(
                     grads, axis_names=(*scaler.model_parallel_axes, "data"))
+            elif self.bucket_bytes is not None:
+                # bucketed epilogue: the finite-check consumes the LOCAL
+                # grads, pmin-synced over (mp axes + data) — the
+                # reference's distributed found_inf allreduce — so the
+                # loss-scale update and skip select depend on one tiny
+                # flag, not on the bucket psums, and XLA can run them
+                # under the bucket transfers. (A finite local tree whose
+                # cross-replica SUM overflows fp32 is the one case this
+                # decides differently from checking the synced grads;
+                # the reference accepts the same trade.)
+                from apex_tpu.amp.scaler import all_finite
+                finite = all_finite(
+                    grads, axis_names=(*scaler.model_parallel_axes, "data"))
+                grads = allreduce_grads(grads, "data",
+                                        bucket_bytes=self.bucket_bytes)
             else:
+                grads = allreduce_grads(grads, "data")
                 finite = scaler.all_finite_synced(grads)
             new_ls = scaler.update(ls, finite)
             new_p, new_s = opt.step(grads, opt_state,

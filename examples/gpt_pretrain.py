@@ -38,6 +38,12 @@ def main(argv=None):
                     help="shard optimizer state 1/dp over the data axis "
                          "(DistributedFusedAdam; reduce_scatter grads, "
                          "all_gather params)")
+    ap.add_argument("--bucket-bytes", type=int, default=None,
+                    help="DP-sync bucket size in bytes: route the grad "
+                         "sync (and the ZeRO reduce_scatter/all_gather) "
+                         "through the bucketed overlap engine in B "
+                         "fixed-size flat fp32 buckets (docs/PERF.md "
+                         "'DP overlap + ZeRO'; default: unbucketed)")
     ap.add_argument("--sequence-parallel", action="store_true",
                     help="Megatron-LM sequence parallelism (tp > 1, "
                          "pp == 1, VMA jax — the trainer refuses on the "
@@ -67,7 +73,7 @@ def main(argv=None):
                           micro_batch_size=mb),
         optimizer=OptimizerConfig(name="adam", lr=1e-3, weight_decay=0.0,
                                   zero=args.zero),
-        opt_level="O0")
+        opt_level="O0", ddp_bucket_bytes=args.bucket_bytes)
 
     mesh = cfg.initialize_mesh()
     trainer = GPTHybridTrainer(cfg, mesh)
@@ -83,7 +89,9 @@ def main(argv=None):
     data = rng.randint(0, args.vocab, (10_000, seq + 1))
     batches = iter(sampler)
 
-    step_fn = jax.jit(trainer.train_step)
+    # donated jit: stage/shared/opt_state update in place — the loop below
+    # only ever touches the returned state, never a consumed buffer
+    step_fn = trainer.jit_train_step()
     loss = None
     try:
         for i in range(args.steps):

@@ -1,17 +1,30 @@
 #!/usr/bin/env python
-"""Static check: no raw ``lax.all_gather`` outside the VMA-safe wrappers.
+"""Static check: collectives stay behind their chokepoints.
 
-Gathers are the one collective whose semantics changed across the jax
-version line this library straddles: on VMA jax ``all_gather`` demands a
-device-varying operand (a replicated-typed value must be ``pcast`` first)
-and there is a separate invariant-typed gather, while on the pre-VMA 0.4.x
-line neither concept exists. ``apex_tpu.utils.vma`` owns both shims
-(:func:`varying_all_gather`, :func:`invariant_all_gather`); a raw
-``jax.lax.all_gather`` sprinkled anywhere else silently works on one
-version and breaks on the other. This script greps the package for stray
-call sites — no jax import, pre-commit fast — and exits non-zero listing
-any. Wired into the test suite via
-``tests/test_observability.py::TestCheckCollectives``.
+Two routing contracts, one fast grep (no jax import, pre-commit fast),
+wired into the test suite via
+``tests/test_observability.py::TestCheckCollectives``:
+
+1. **Gathers** — the one collective whose semantics changed across the jax
+   version line this library straddles: on VMA jax ``all_gather`` demands a
+   device-varying operand (a replicated-typed value must be ``pcast``
+   first) and there is a separate invariant-typed gather, while on the
+   pre-VMA 0.4.x line neither concept exists. ``apex_tpu.utils.vma`` owns
+   both shims (:func:`varying_all_gather`, :func:`invariant_all_gather`);
+   a raw ``jax.lax.all_gather`` sprinkled anywhere else silently works on
+   one version and breaks on the other.
+
+2. **Gradient syncs** — ``apex_tpu.parallel.distributed`` is the bucketing
+   engine: every DP grad reduction must flow through
+   :func:`allreduce_grads` / :func:`grouped_psum` /
+   :func:`reduce_scatter_grads` so ``bucket_bytes`` policy, telemetry
+   (``ddp/*``), and the health watchdog see it. Raw ``lax.psum_scatter``
+   is flagged package-wide outside the chokepoint module (the only other
+   legitimate holder is the context-parallel *activation* scatter, which
+   is not a grad sync and is allowlisted); raw ``lax.psum`` /
+   ``lax.psum_scatter`` are flagged inside the grad-handling modules
+   (``training.py``, ``optimizers/``), where any psum IS a grad-path
+   reduction or belongs in the chokepoint anyway.
 
 Usage::
 
@@ -28,18 +41,44 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGE = "apex_tpu"
 
+
+def _p(*parts: str) -> str:
+    return os.path.join(*parts)
+
+
 # the only modules allowed to touch lax.all_gather directly: the VMA shims
 # themselves and the version-compat layer
-ALLOWED = {
-    os.path.join("apex_tpu", "utils", "vma.py"),
-    os.path.join("apex_tpu", "utils", "compat.py"),
+ALLOWED_GATHER = {
+    _p("apex_tpu", "utils", "vma.py"),
+    _p("apex_tpu", "utils", "compat.py"),
 }
 
-# `lax.all_gather(` catches `jax.lax.all_gather(` and `from jax import lax;
-# lax.all_gather(`; the word boundary keeps `all_gather_invariant` (the
-# private symbol vma.py wraps) and mention-in-docstring text like
-# "all_gather the shards" out
-_PATTERN = re.compile(r"lax\.all_gather\s*\(")
+# lax.psum_scatter: the grad-sync chokepoint (reduce_scatter_grads), plus
+# the context-parallel sequence-dim scatter — an ACTIVATION collective
+# (RowParallel output path along the sequence axis), not a gradient sync,
+# so it does not belong behind the bucketing engine
+ALLOWED_SCATTER = {
+    _p("apex_tpu", "parallel", "distributed.py"),
+    _p("apex_tpu", "transformer", "context_parallel.py"),
+}
+
+# modules whose psums are gradient-path reductions by construction: any
+# raw lax.psum / lax.psum_scatter here must route through the
+# parallel/distributed.py chokepoints (allreduce_grads / grouped_psum /
+# reduce_scatter_grads) so bucketing policy cannot be bypassed
+GRAD_SYNC_PREFIXES = (
+    _p("apex_tpu", "training.py"),
+    _p("apex_tpu", "optimizers") + os.sep,
+)
+
+_GATHER = re.compile(r"lax\.all_gather\s*\(")
+_SCATTER = re.compile(r"lax\.psum_scatter\s*\(")
+_PSUM = re.compile(r"lax\.psum\s*\(")
+
+
+def _hits(pattern: re.Pattern, source: str):
+    return [i + 1 for i, line in enumerate(source.splitlines())
+            if pattern.search(line)]
 
 
 def check(repo: str = REPO):
@@ -55,21 +94,47 @@ def check(repo: str = REPO):
             rel = os.path.relpath(path, repo)
             with open(path) as f:
                 source = f.read()
-            hits = [i + 1 for i, line in enumerate(source.splitlines())
-                    if _PATTERN.search(line)]
-            if not hits:
-                continue
-            if rel in ALLOWED:
-                lines.append(f"ok       {rel}: wrapper module "
-                             f"(lines {', '.join(map(str, hits))})")
-            else:
-                ok = False
-                for ln in hits:
-                    lines.append(
-                        f"RAW      {rel}:{ln}: lax.all_gather outside the "
-                        f"VMA-safe wrappers — use "
-                        f"apex_tpu.utils.vma.varying_all_gather (or "
-                        f"invariant_all_gather)")
+
+            hits = _hits(_GATHER, source)
+            if hits:
+                if rel in ALLOWED_GATHER:
+                    lines.append(f"ok       {rel}: gather wrapper module "
+                                 f"(lines {', '.join(map(str, hits))})")
+                else:
+                    ok = False
+                    for ln in hits:
+                        lines.append(
+                            f"RAW      {rel}:{ln}: lax.all_gather outside "
+                            f"the VMA-safe wrappers — use "
+                            f"apex_tpu.utils.vma.varying_all_gather (or "
+                            f"invariant_all_gather)")
+
+            hits = _hits(_SCATTER, source)
+            if hits:
+                if rel in ALLOWED_SCATTER:
+                    lines.append(f"ok       {rel}: psum_scatter chokepoint/"
+                                 f"allowlisted "
+                                 f"(lines {', '.join(map(str, hits))})")
+                else:
+                    ok = False
+                    for ln in hits:
+                        lines.append(
+                            f"RAW      {rel}:{ln}: lax.psum_scatter outside "
+                            f"the grad-sync chokepoint — use apex_tpu."
+                            f"parallel.distributed.reduce_scatter_grads "
+                            f"(bucketing/telemetry ride on it)")
+
+            if rel.startswith(GRAD_SYNC_PREFIXES):
+                psum_hits = _hits(_PSUM, source)
+                if psum_hits:
+                    ok = False
+                    for ln in psum_hits:
+                        lines.append(
+                            f"RAW      {rel}:{ln}: raw lax.psum in a "
+                            f"grad-sync module — route through apex_tpu."
+                            f"parallel.distributed (allreduce_grads / "
+                            f"grouped_psum) so bucketing policy and ddp/* "
+                            f"telemetry cannot be bypassed")
     return ok, lines
 
 
@@ -77,17 +142,24 @@ def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if "--list" in argv:
         print("allowed lax.all_gather call sites:")
-        for rel in sorted(ALLOWED):
+        for rel in sorted(ALLOWED_GATHER):
+            print(f"  {rel}")
+        print("allowed lax.psum_scatter call sites:")
+        for rel in sorted(ALLOWED_SCATTER):
+            print(f"  {rel}")
+        print("grad-sync modules (no raw lax.psum/lax.psum_scatter):")
+        for rel in GRAD_SYNC_PREFIXES:
             print(f"  {rel}")
         return 0
     ok, lines = check()
     for line in lines:
         print(line)
     if not ok:
-        print("raw all_gather call sites found — route them through "
-              "apex_tpu/utils/vma.py so the pre-VMA 0.4.x path keeps "
-              "working (or extend ALLOWED in scripts/check_collectives.py "
-              "with justification)", file=sys.stderr)
+        print("raw collective call sites found — route gathers through "
+              "apex_tpu/utils/vma.py and grad syncs through "
+              "apex_tpu/parallel/distributed.py (or extend the allowlists "
+              "in scripts/check_collectives.py with justification)",
+              file=sys.stderr)
     return 0 if ok else 1
 
 

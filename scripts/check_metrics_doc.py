@@ -2,7 +2,7 @@
 """Static check: every in-graph metric recorded in source is documented.
 
 The per-step metric families (``health/*``, ``tp/*``, ``amp/*``,
-``ddp/*``, ``pipeline/*``, ``optim/*``) are a public contract — dashboards
+``ddp/*``, ``pipeline/*``, ``optim/*``, ``zero/*``) are a public contract — dashboards
 and the crash-dump post-mortem workflow key on the names — and the
 contract lives in the docs/OBSERVABILITY.md table. A ``record()`` call
 added without a doc row silently grows an undocumented surface; this
@@ -34,7 +34,8 @@ DOC = os.path.join("docs", "OBSERVABILITY.md")
 
 # metric families under the documentation contract; names outside these
 # prefixes (host registry internals, ad-hoc example metrics) are exempt
-PREFIXES = ("health/", "tp/", "amp/", "ddp/", "pipeline/", "optim/")
+PREFIXES = ("health/", "tp/", "amp/", "ddp/", "pipeline/", "optim/",
+            "zero/")
 
 _PLACEHOLDER = re.compile(r"<[^<>`]*>")
 

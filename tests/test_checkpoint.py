@@ -161,7 +161,8 @@ def test_bitwise_resume_distributed_fused_adam(tmp_path):
         lambda p: jnp.asarray(rng.randn(DP, *np.shape(p)), jnp.float32),
         params)
     state_spec = ZeroAdamState(step=P(), master=P("data"),
-                               exp_avg=P("data"), exp_avg_sq=P("data"))
+                               exp_avg=P("data"), exp_avg_sq=P("data"),
+                               bucket_stamp=P())
     gspec = jax.tree_util.tree_map(lambda _: P("data"), grads_stacked)
 
     @jax.jit

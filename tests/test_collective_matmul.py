@@ -208,10 +208,7 @@ def test_matmul_reduce_scatter_rejects_indivisible_seq(mesh_tp2):
 # jaxpr shape: the decomposition is real (acceptance criterion)
 # ---------------------------------------------------------------------------
 
-def _census(jaxpr_str):
-    return {"ppermute": jaxpr_str.count("ppermute"),
-            "all_gather": jaxpr_str.count("all_gather"),
-            "reduce_scatter": jaxpr_str.count("reduce_scatter")}
+from _jaxpr_utils import collective_census as _census  # noqa: E402
 
 
 def test_jaxpr_ring_decomposition_primitives(mesh_tp):

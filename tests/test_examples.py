@@ -38,11 +38,12 @@ def test_gpt_pretrain_runs():
 
 def test_gpt_pretrain_zero_runs():
     """--zero swaps in the ZeRO sharded optimizer (DistributedFusedAdam)
-    inside the same hybrid trainer; the loss trajectory must stay finite
-    and positive."""
+    inside the same hybrid trainer — here with --bucket-bytes, so the
+    example drives the per-bucket reduce_scatter/all_gather overlap path;
+    the loss trajectory must stay finite and positive."""
     import gpt_pretrain
     loss = gpt_pretrain.main(["--tp", "2", "--pp", "2", "--steps", "2",
-                              "--zero"])
+                              "--zero", "--bucket-bytes", "4096"])
     assert loss > 0
 
 

@@ -478,12 +478,7 @@ def _small_cfg():
     return cfg, tokens, targets
 
 
-def _jaxpr_str(fn, *args):
-    """Jaxpr text with embedded object addresses normalized: two trainers
-    build distinct model closures, and their reprs (`<function ... at
-    0x...>`) would differ even when the traced programs are identical."""
-    import re
-    return re.sub(r"0x[0-9a-f]+", "0x", str(jax.make_jaxpr(fn)(*args)))
+from _jaxpr_utils import jaxpr_str as _jaxpr_str  # noqa: E402
 
 
 def test_trainer_health_off_is_jaxpr_identical_and_cheap_attributes():

@@ -853,12 +853,16 @@ class TestCheckCollectives:
             capture_output=True, text=True)
         assert proc.returncode == 0, proc.stdout + proc.stderr
 
-    def test_detects_raw_all_gather(self, tmp_path):
+    def _mod(self):
         import importlib.util
         spec = importlib.util.spec_from_file_location(
             "check_collectives", "scripts/check_collectives.py")
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
+        return mod
+
+    def test_detects_raw_all_gather(self, tmp_path):
+        mod = self._mod()
         # plant a stray raw gather in a fake package tree
         pkg = tmp_path / "apex_tpu" / "transformer"
         pkg.mkdir(parents=True)
@@ -873,6 +877,42 @@ class TestCheckCollectives:
         ok, lines = mod.check()
         assert ok, "\n".join(lines)
 
+    def test_detects_raw_psum_scatter_outside_chokepoint(self, tmp_path):
+        """A raw psum_scatter anywhere but the distributed.py chokepoint
+        (or the allowlisted context-parallel activation scatter) is
+        flagged — grad syncs cannot bypass the bucketing engine."""
+        mod = self._mod()
+        pkg = tmp_path / "apex_tpu" / "transformer"
+        pkg.mkdir(parents=True)
+        (pkg / "bad.py").write_text(
+            "import jax\n"
+            "def sync(g):\n"
+            "    return jax.lax.psum_scatter(g, 'data', tiled=True)\n")
+        ok, lines = mod.check(repo=str(tmp_path))
+        assert not ok
+        assert any("bad.py:3" in l and "psum_scatter" in l for l in lines)
+        assert any("reduce_scatter_grads" in l for l in lines)
+
+    def test_detects_raw_psum_in_grad_sync_modules(self, tmp_path):
+        """Inside training.py / optimizers/, raw lax.psum is a grad-path
+        reduction by construction — flagged; the same line outside the
+        grad-sync modules is not."""
+        mod = self._mod()
+        opt = tmp_path / "apex_tpu" / "optimizers"
+        opt.mkdir(parents=True)
+        src = ("import jax\n"
+               "def sync(g):\n"
+               "    return jax.lax.psum(g, 'data')\n")
+        (opt / "bad.py").write_text(src)
+        elsewhere = tmp_path / "apex_tpu" / "normalization"
+        elsewhere.mkdir(parents=True)
+        (elsewhere / "fine.py").write_text(src)
+        ok, lines = mod.check(repo=str(tmp_path))
+        assert not ok
+        flagged = [l for l in lines if "RAW" in l]
+        assert any("bad.py:3" in l and "grad-sync" in l for l in flagged)
+        assert not any("fine.py" in l for l in flagged)
+
 
 # ---------------------------------------------------------------------------
 # metric-name documentation contract (no undocumented health/tp/amp/...)
@@ -886,7 +926,7 @@ class TestCheckMetricsDoc:
         assert proc.returncode == 0, proc.stdout + proc.stderr
         # the known families all show up as checked
         for family in ("health/", "amp/", "ddp/", "pipeline/", "optim/",
-                       "tp/"):
+                       "tp/", "zero/"):
             assert family in proc.stdout, family
 
     def _mod(self):

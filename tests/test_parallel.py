@@ -11,6 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
+from _jaxpr_utils import jaxpr_str
 from apex_tpu.utils.compat import shard_map
 
 from apex_tpu.parallel import (
@@ -300,8 +301,8 @@ def test_delay_allreduce_returns_unsynced_grads():
                                           delay_allreduce=True), True)
     synced = run(DistributedDataParallel(axis_name="data"), False)
     # the delayed jaxpr has no psum; the synced one has exactly one
-    assert str(jax.make_jaxpr(delayed)(w, x, y)).count("psum") == 0
-    assert str(jax.make_jaxpr(synced)(w, x, y)).count("psum") == 1
+    assert jaxpr_str(delayed, w, x, y).count("psum") == 0
+    assert jaxpr_str(synced, w, x, y).count("psum") == 1
     # and its value is each replica's own-shard grad, not the mean
     g_delay = jax.jit(delayed)(w, x, y)  # (8, 4, 1): per-rank grads
     g_sync = jax.jit(synced)(w, x, y)
@@ -345,7 +346,7 @@ def test_accumulate_gradients_single_psum():
                          out_specs=(P("data"), P()))(w, xs, ys)
 
     # exactly one psum per accumulation window (single-leaf params)
-    assert str(jax.make_jaxpr(run)(w, xs, ys)).count("psum") == 1
+    assert jaxpr_str(run, w, xs, ys).count("psum") == 1
 
     _, g = jax.jit(run)(w, xs, ys)
 
