@@ -33,7 +33,7 @@ _LAZY_SUBMODULES = (
     "optimizers", "normalization", "ops", "parallel", "transformer",
     "contrib", "utils", "fp16_utils", "models", "multi_tensor_apply",
     "RNN", "reparameterization", "checkpoint", "config", "pyprof",
-    "observability",
+    "observability", "remat",
 )
 
 

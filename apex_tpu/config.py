@@ -54,7 +54,17 @@ class ModelConfig:
     hidden_dropout: float = 0.0
     attention_dropout: float = 0.0
     num_classes: int = 1000           # resnet head
+    # Activation rematerialization (gpt/bert). ``remat_policy``:
+    # None | "none" | "full" | "selective" | "offload" — the named-policy
+    # knob (apex_tpu/remat.py; "selective" keeps GEMM/flash outputs
+    # resident, recomputing only the cheap LN/gelu tier). ``remat: bool``
+    # is the deprecated all-or-nothing spelling, honored (True -> "full",
+    # with a DeprecationWarning) only while remat_policy is None.
+    # ``remat_names``: custom save/offload list for the name-based modes
+    # (members of remat.CHECKPOINT_NAMES).
     remat: bool = False
+    remat_policy: Optional[str] = None
+    remat_names: Optional[Tuple[str, ...]] = None
     # Megatron-LM sequence parallelism (gpt only; needs tp > 1, pp == 1;
     # through GPTHybridTrainer additionally needs VMA jax — the trainer
     # refuses on the pre-VMA 0.4.x line, see training.py)
@@ -145,6 +155,8 @@ class TrainConfig:
                 if field == "batch" and sub_d.get("rampup_batch_size"):
                     sub_d["rampup_batch_size"] = tuple(
                         sub_d["rampup_batch_size"])
+                if field == "model" and sub_d.get("remat_names"):
+                    sub_d["remat_names"] = tuple(sub_d["remat_names"])
                 d[field] = sub(**sub_d)
         return cls(**d)
 
@@ -189,6 +201,7 @@ class TrainConfig:
                 compute_dtype=pol.compute_dtype,
                 hidden_dropout=m.hidden_dropout,
                 attention_dropout=m.attention_dropout, remat=m.remat,
+                remat_policy=m.remat_policy, remat_names=m.remat_names,
                 sequence_parallel=m.sequence_parallel,
                 tp_comm_overlap=m.tp_comm_overlap))
         if m.name == "bert":
@@ -198,6 +211,8 @@ class TrainConfig:
                 num_layers=m.num_layers,
                 num_attention_heads=m.num_attention_heads,
                 max_position_embeddings=m.max_position_embeddings,
+                remat=m.remat, remat_policy=m.remat_policy,
+                remat_names=m.remat_names,
                 compute_dtype=pol.compute_dtype))
         if m.name == "resnet50":
             from apex_tpu.models import ResNet50, ResNetConfig

@@ -75,16 +75,18 @@ class BertModel(GPTModel):
         b, s, _ = x.shape
         local_heads = cfg.num_attention_heads // cfg.tensor_model_parallel_size
         qkv, _ = self.qkv(lp["qkv"], x)
+        qkv = self._tag(qkv, "qkv_out")
         qkv = qkv.reshape(b, s, local_heads, 3 * cfg.head_dim)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q, k, v = (jnp.transpose(t, (0, 2, 1, 3)) for t in (q, k, v))
         rate = cfg.attention_dropout if attn_seed is not None else 0.0
         ctx = flash_attention(q, k, v, bias=bias, causal=False,
                               use_pallas=cfg.use_flash,
-                              dropout_rate=rate, dropout_seed=attn_seed)
+                              dropout_rate=rate, dropout_seed=attn_seed,
+                              checkpoint_names=self.remat_policy.uses_names)
         ctx = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(b, s, -1)
         out, _ = self.proj(lp["proj"], ctx)
-        return out
+        return self._tag(out, "attn_proj_out")
 
     def _layer(self, lp, x, bias=None, lrng=None):
         cfg = self.cfg
@@ -118,9 +120,7 @@ class BertModel(GPTModel):
             bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
                              -10000.0).astype(jnp.float32)
 
-        layer_fn = self._layer
-        if cfg.remat:
-            layer_fn = jax.checkpoint(layer_fn)
+        layer_fn = self.remat_policy.wrap(self._layer)
         use_dropout = dropout_rng is not None and (
             cfg.hidden_dropout > 0.0 or cfg.attention_dropout > 0.0)
 

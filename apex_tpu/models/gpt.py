@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from apex_tpu.normalization import fused_layer_norm_affine
 from apex_tpu.ops.dropout import dropout
+from apex_tpu.remat import RematPolicy, tag as _remat_tag
 from apex_tpu.ops.flash_attention import flash_attention
 from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
 from apex_tpu.transformer import tensor_parallel as tp_mod
@@ -58,7 +59,16 @@ class GPTConfig:
     compute_dtype: Any = jnp.bfloat16
     init_method_std: float = 0.02
     layernorm_epsilon: float = 1e-5
-    remat: bool = False          # per-layer activation checkpointing
+    # Per-layer activation rematerialization. ``remat_policy`` is the
+    # knob: None | "none" | "full" | "selective" | "offload" | a
+    # remat.RematPolicy instance ("selective" keeps the registry-tagged
+    # GEMM/flash outputs resident and recomputes only the cheap LN/gelu
+    # tier — see apex_tpu/remat.py). ``remat: bool`` is the deprecated
+    # pre-policy spelling, honored (True -> "full") when remat_policy is
+    # None. ``remat_names``: custom save-list for the name-based modes.
+    remat: bool = False
+    remat_policy: Any = None
+    remat_names: Optional[Tuple[str, ...]] = None
     use_flash: Optional[bool] = None  # None = auto by shape/backend
     # Megatron-LM sequence parallelism: norms/dropout/residuals run on
     # (b, s/tp, h) sequence shards; ColumnParallel inputs all-gather the
@@ -98,6 +108,31 @@ class GPTModel:
         if cfg.num_attention_heads % cfg.tensor_model_parallel_size:
             raise ValueError("heads must divide tp size")
         self.cfg = cfg
+        # remat policy resolved ONCE (the deprecation warning for the
+        # legacy bool fires here); models gate their checkpoint_name tags
+        # on uses_names so none/full programs stay tag-free and
+        # jaxpr-identical to the pre-policy ones
+        policy = RematPolicy.resolve(
+            cfg.remat_policy, legacy_bool=cfg.remat,
+            owner=type(cfg).__name__)
+        if cfg.remat_names is not None:
+            if not policy.uses_names:
+                raise ValueError(
+                    "remat_names requires a name-based remat_policy "
+                    "('selective' or 'offload'), got "
+                    f"{policy.mode!r}")
+            if policy.names is not None and policy.names != tuple(
+                    cfg.remat_names):
+                raise ValueError(
+                    "conflicting save-lists: remat_policy carries "
+                    f"names={policy.names!r} but remat_names="
+                    f"{tuple(cfg.remat_names)!r}; set the list in one "
+                    "place")
+            policy = dataclasses.replace(
+                policy, names=tuple(cfg.remat_names))
+        self.remat_policy = policy
+        self._tag = (_remat_tag if policy.uses_names
+                     else (lambda x, name: x))
         tp = cfg.tensor_model_parallel_size
         init = init_method_normal(cfg.init_method_std)
         # output-layer init scaled by sqrt(2*layers) (standalone_gpt.py
@@ -192,7 +227,9 @@ class GPTModel:
         out = fused_layer_norm_affine(
             x, p["weight"].astype(x.dtype), p["bias"].astype(x.dtype),
             self.cfg.hidden_size, eps=self.cfg.layernorm_epsilon)
-        return out
+        # dropped by the selective policy: recomputing an LN is one fused
+        # elementwise pass — the cheap tier selective remat exists to shed
+        return self._tag(out, "ln_out")
 
     def _attention(self, lp: dict, x: jnp.ndarray,
                    attn_seed=None) -> jnp.ndarray:
@@ -201,6 +238,7 @@ class GPTModel:
         local_heads = cfg.num_attention_heads // cfg.tensor_model_parallel_size
         qkv, _ = self.qkv(lp["qkv"], x)  # (b, s_full, 3*h/tp) — under SP
         # the ColumnParallel input gather restores the full sequence here
+        qkv = self._tag(qkv, "qkv_out")
         s = qkv.shape[1]
         qkv = qkv.reshape(b, s, local_heads, 3 * cfg.head_dim)
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -210,16 +248,20 @@ class GPTModel:
         rate = cfg.attention_dropout if attn_seed is not None else 0.0
         ctx = flash_attention(q, k, v, causal=True,
                               use_pallas=cfg.use_flash,
-                              dropout_rate=rate, dropout_seed=attn_seed)
+                              dropout_rate=rate, dropout_seed=attn_seed,
+                              checkpoint_names=self.remat_policy.uses_names)
         ctx = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(b, s, -1)
         out, _ = self.proj(lp["proj"], ctx)
-        return out
+        return self._tag(out, "attn_proj_out")
 
     def _mlp(self, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
         h, _ = self.fc1(lp["fc1"], x)
+        # tagged PRE-gelu: saving the GEMM output costs the same bytes and
+        # leaves only the elementwise gelu to recompute for fc2's dW
+        h = self._tag(h, "mlp_fc1_out")
         h = jax.nn.gelu(h, approximate=True)
         out, _ = self.fc2(lp["fc2"], h)
-        return out
+        return self._tag(out, "mlp_fc2_out")
 
     def _layer(self, lp: dict, x: jnp.ndarray, lrng=None) -> jnp.ndarray:
         cfg = self.cfg
@@ -321,9 +363,7 @@ class GPTModel:
         cfg = self.cfg
         if cfg.tp_comm_overlap:
             self.record_tp_overlap(x.shape)
-        layer_fn = self._layer
-        if cfg.remat:
-            layer_fn = jax.checkpoint(layer_fn)
+        layer_fn = self.remat_policy.wrap(self._layer)
         use_dropout = dropout_rng is not None and (
             cfg.hidden_dropout > 0.0 or cfg.attention_dropout > 0.0)
 
@@ -421,9 +461,7 @@ class GPTModel:
         per = self.cfg.num_layers // num_stages
 
         def stage(stage_params: dict, x: jnp.ndarray, stage_idx) -> jnp.ndarray:
-            layer_fn = self._layer
-            if self.cfg.remat:
-                layer_fn = jax.checkpoint(layer_fn)
+            layer_fn = self.remat_policy.wrap(self._layer)
 
             def body(x, lp):
                 return layer_fn(lp, x), None

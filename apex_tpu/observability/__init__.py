@@ -54,4 +54,4 @@ from apex_tpu.observability.health import (  # noqa: F401
     CrashDump, HealthConfig, HealthMonitor, NonFiniteError, TreeStats,
     check_replica_agreement, decode_attribution, tensor_stats)
 from apex_tpu.observability.costs import (  # noqa: F401
-    flops_budget, mfu, peak_flops)
+    flops_budget, memory_budget, mfu, peak_flops)

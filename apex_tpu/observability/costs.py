@@ -1,4 +1,5 @@
-"""Peak-flops table and MFU math — one source of truth.
+"""Peak-flops table, MFU math, and compiled-memory budgets — one source of
+truth.
 
 ``bench.py`` grew a hand-rolled device-kind -> peak-bf16-flops table and a
 ``compiled.cost_analysis()`` extraction for its MFU columns; the
@@ -11,15 +12,20 @@ as a live gauge. Both now read from here:
   executable via XLA's cost analysis (None when the backend reports
   nothing useful — notably, Mosaic custom calls report zero flops, so GPT
   steps with flash attention should prefer an analytic count);
-- :func:`mfu` — model-flops-utilization: achieved FLOP/s over peak.
+- :func:`mfu` — model-flops-utilization: achieved FLOP/s over peak;
+- :func:`memory_budget` — the executable's static memory plan from
+  ``compiled.memory_analysis()`` (argument/output/temp/peak bytes) — the
+  number that makes an activation-remat policy choice measurable instead
+  of vibes (``StepReporter.attach_memory_budget`` turns it into the
+  ``mem/*`` gauge family; ``bench.py`` records it next to step_ms).
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Optional
 
 __all__ = ["PEAK_BF16_FLOPS", "DEFAULT_PEAK_FLOPS", "peak_flops",
-           "flops_budget", "mfu"]
+           "flops_budget", "memory_budget", "mfu"]
 
 # peak dense bf16 TFLOP/s per chip by device kind (public spec sheets)
 PEAK_BF16_FLOPS = {
@@ -70,6 +76,52 @@ def flops_budget(compiled) -> Optional[float]:
     if not (0.0 < flops < float("inf")):  # rejects NaN, ±inf, <= 0
         return None
     return flops
+
+
+def memory_budget(compiled) -> Optional[Dict[str, int]]:
+    """Static memory plan of a compiled executable
+    (``jit(f).lower(...).compile()``), from ``compiled.memory_analysis()``.
+
+    Returns None when the backend exposes no analysis; otherwise a dict of
+
+    - ``argument_bytes`` / ``output_bytes`` — buffers entering/leaving the
+      program (donated/aliased bytes already netted out via
+      ``alias_bytes``);
+    - ``temp_bytes`` — XLA's scratch high-water for the program body: the
+      activation/residual working set. THIS is the number an activation-
+      remat policy moves (``none > selective > full`` on a train step);
+    - ``alias_bytes`` — input/output-aliased (donated) bytes;
+    - ``generated_code_bytes`` — the program text itself;
+    - ``host_temp_bytes`` — host-memory scratch: nonzero exactly when an
+      ``offload`` remat policy (or any host-memory placement) is in play;
+    - ``peak_hbm_bytes`` — the device high-water estimate
+      ``argument + output + temp + generated_code - alias`` (the standard
+      XLA accounting: arguments and outputs are resident for the whole
+      program, donation collapses the aliased pairs).
+    """
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+
+    def _get(attr: str) -> int:
+        return int(getattr(ma, attr, 0) or 0)
+
+    out = {
+        "argument_bytes": _get("argument_size_in_bytes"),
+        "output_bytes": _get("output_size_in_bytes"),
+        "temp_bytes": _get("temp_size_in_bytes"),
+        "alias_bytes": _get("alias_size_in_bytes"),
+        "generated_code_bytes": _get("generated_code_size_in_bytes"),
+        "host_temp_bytes": _get("host_temp_size_in_bytes"),
+    }
+    out["peak_hbm_bytes"] = (out["argument_bytes"] + out["output_bytes"]
+                             + out["temp_bytes"]
+                             + out["generated_code_bytes"]
+                             - out["alias_bytes"])
+    return out
 
 
 def mfu(flops_per_step: float, step_time_s: float,

@@ -58,6 +58,13 @@ class StepReporter:
     on a ``perf/mfu`` gauge: model-flops-utilization computed from the
     wall time between consecutive reports, against
     :func:`~apex_tpu.observability.costs.peak_flops` by default.
+
+    :meth:`attach_memory_budget` sets the ``mem/*`` gauge family
+    (``mem/peak_hbm_bytes``, ``mem/temp_bytes``, ...) from the compiled
+    step's :func:`~apex_tpu.observability.costs.memory_budget` — static
+    per executable, so attach once after AOT compile and every snapshot
+    carries the step's HBM plan next to its live metrics (the accounting
+    that makes an activation-remat policy choice measurable).
     """
 
     def __init__(self, sinks: Sequence[Sink],
@@ -102,6 +109,26 @@ class StepReporter:
                              f"got {flops} and {peak}")
         self._flops_per_step = flops
         self._peak_flops = peak
+        return self
+
+    def attach_memory_budget(self, budget) -> "StepReporter":
+        """Set the ``mem/*`` gauges from ``budget`` — either the dict
+        returned by :func:`~apex_tpu.observability.costs.memory_budget`
+        or a compiled executable to extract it from. A backend without
+        memory analysis (``memory_budget(...) is None``) leaves the
+        gauges unset rather than reporting zeros. Returns self for
+        chaining."""
+        if budget is not None and not isinstance(budget, dict):
+            from apex_tpu.observability.costs import memory_budget
+            budget = memory_budget(budget)
+        if budget is None:
+            return self
+        reg = self.registry
+        reg.gauge("mem/peak_hbm_bytes").set(budget["peak_hbm_bytes"])
+        reg.gauge("mem/temp_bytes").set(budget["temp_bytes"])
+        reg.gauge("mem/argument_bytes").set(budget["argument_bytes"])
+        reg.gauge("mem/output_bytes").set(budget["output_bytes"])
+        reg.gauge("mem/host_temp_bytes").set(budget["host_temp_bytes"])
         return self
 
     def _update_mfu(self, step: int) -> None:

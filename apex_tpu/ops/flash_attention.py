@@ -774,7 +774,8 @@ def _dbias_pallas(q3, k3, v3, bias4, seed, segs, h, do3, lse, delta, *,
 @functools.lru_cache(maxsize=None)
 def _make_flash(scale: float, causal: bool, block_q: int, block_k: int,
                 has_bias: bool, need_dbias: bool, h: int,
-                dropout_rate: float, has_seg: bool):
+                dropout_rate: float, has_seg: bool,
+                checkpoint_names: bool = False):
     def _segs(qs, ks):
         return (qs, ks) if has_seg else None
 
@@ -791,6 +792,17 @@ def _make_flash(scale: float, causal: bool, block_q: int, block_k: int,
                                _segs(qseg, kseg),
                                h, scale=scale, causal=causal, block_q=block_q,
                                block_k=block_k, dropout_rate=dropout_rate)
+        if checkpoint_names:
+            # Tag the kernel residuals INSIDE the fwd rule (the trace a
+            # name-based jax.checkpoint policy sees under AD). Saving the
+            # context alone would not keep the forward kernel out of the
+            # recompute — the backward kernels also consume the logsumexp,
+            # and an unsaved residual forces the fwd kernel to rerun in
+            # the remat region. With both tagged, DCE drops the fwd kernel
+            # from the recomputed set entirely (see apex_tpu/remat.py).
+            from apex_tpu.remat import tag as _remat_tag
+            out = _remat_tag(out, "flash_ctx")
+            lse = _remat_tag(lse, "flash_lse")
         return out, (q3, k3, v3, bias4, seed, qseg, kseg, out, lse)
 
     def bwd(res, do3):
@@ -838,7 +850,8 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
                     bias_requires_grad: bool = False,
                     dropout_rate: float = 0.0,
                     dropout_seed=None,
-                    segment_ids=None):
+                    segment_ids=None,
+                    checkpoint_names: bool = False):
     """Fused attention over ``(b, h, s, d)`` tensors.
 
     ``segment_ids``: packed-sequence (varlen) attention — the TPU-native
@@ -863,6 +876,13 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
     :func:`~apex_tpu.transformer.tensor_parallel.random.get_rng_tracker`);
     required when ``dropout_rate > 0``.
 
+    ``checkpoint_names``: emit the ``flash_ctx``/``flash_lse``
+    ``jax.ad_checkpoint.checkpoint_name`` tags (registry:
+    ``apex_tpu/remat.py``) so a name-based remat policy can keep the
+    kernel's residuals resident and the forward kernel out of the
+    recomputed set. Off by default so untagged programs stay
+    jaxpr-identical to the pre-policy ones.
+
     Falls back to the XLA reference when shapes aren't tile-aligned (same
     dropout mask and same zero-bias-grad semantics on both paths).
     """
@@ -883,10 +903,17 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
         # silently flip with tile alignment
         if bias is not None and not bias_requires_grad:
             bias = jax.lax.stop_gradient(bias)
-        return mha_reference(q, k, v, bias, causal, softmax_scale,
-                             dropout_rate=dropout_rate,
-                             dropout_seed=dropout_seed,
-                             segment_ids=segment_ids)
+        out = mha_reference(q, k, v, bias, causal, softmax_scale,
+                            dropout_rate=dropout_rate,
+                            dropout_seed=dropout_seed,
+                            segment_ids=segment_ids)
+        if checkpoint_names:
+            # no custom_vjp on the XLA path — tagging the context still
+            # lets name policies keep it resident (the plain-op attention
+            # body is recomputed, which is exactly XLA ops, no kernel)
+            from apex_tpu.remat import tag as _remat_tag
+            out = _remat_tag(out, "flash_ctx")
+        return out
 
     q3 = q.reshape(b * h, sq, d)
     k3 = k.reshape(b * h, sk, d)
@@ -925,7 +952,8 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
         qseg = kseg = jnp.zeros((), jnp.float32)  # placeholder leaf
     fn = _make_flash(float(softmax_scale), bool(causal), block_q, block_k,
                      has_bias, bool(bias_requires_grad), h,
-                     float(dropout_rate), has_seg)
+                     float(dropout_rate), has_seg,
+                     bool(checkpoint_names))
     with jax.named_scope("flash_attention"):
         out = fn(q3, k3, v3, bias4, seed, qseg, kseg)
     return out.reshape(b, h, sq, d)

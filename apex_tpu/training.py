@@ -132,6 +132,14 @@ class GPTHybridTrainer:
         self.bucket_bytes = cfg.ddp_bucket_bytes
         self.pp = cfg.parallel.pipeline_model_parallel_size
         self.model = cfg.build_model()
+        # Activation-remat policy (apex_tpu/remat.py), resolved by the
+        # model from ModelConfig.remat_policy / the deprecated remat bool.
+        # The pipelined stage_fn is wrapped inside the model, so the
+        # schedules' own remat flag stays False here; surfaced for
+        # introspection and for the bench/report plumbing
+        # (StepReporter.attach_memory_budget makes the policy's HBM trade
+        # measurable as mem/* gauges).
+        self.remat_policy = getattr(self.model, "remat_policy", None)
         if (getattr(self.model.cfg, "sequence_parallel", False)
                 and not HAS_VMA):
             # The step runs under shard_map_unchecked, which relaxes

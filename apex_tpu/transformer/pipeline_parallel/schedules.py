@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.observability import ingraph as _metrics
+from apex_tpu.remat import RematPolicy
 from apex_tpu.transformer.parallel_state import PIPE_AXIS
 from apex_tpu.utils.vma import cast_to_vma
 from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
@@ -93,7 +94,7 @@ def forward_backward_no_pipelining(
     grad_scale: Any = 1.0,
     loss_fn: Optional[Callable] = None,
     num_model_chunks: Optional[int] = None,
-    remat: bool = False,
+    remat: Any = False,
 ) -> Tuple[jnp.ndarray, Any]:
     """``fwd_bwd_no_pipelining.py:31-103``: loop microbatches, accumulate.
 
@@ -116,9 +117,7 @@ def forward_backward_no_pipelining(
     if loss_fn is not None:
         if num_model_chunks not in (None, 1):
             raise ValueError("pp=1 runs have a single model chunk")
-        stage_fn = forward_step_func
-        if remat:
-            stage_fn = jax.checkpoint(stage_fn)
+        stage_fn = RematPolicy.resolve(remat).wrap(forward_step_func)
 
         def uniform_step(params, mb_with_index):
             mb, m = mb_with_index
@@ -168,7 +167,7 @@ def pipelined_apply(
     microbatches: jnp.ndarray,
     *,
     num_chunks: int = 1,
-    remat: bool = False,
+    remat: Any = False,
     last_stage_fn: Optional[Callable] = None,
     embed_fn: Optional[Callable] = None,
 ) -> jnp.ndarray:
@@ -216,6 +215,10 @@ def pipelined_apply(
     L = S * num_chunks
     T = M + L - 1
     _record_schedule_metrics(M, T, M)
+    # bool | mode string | RematPolicy — "full" (== the legacy True) is
+    # plain jax.checkpoint; the name-based policies save/offload the
+    # registry-tagged activations the stage_fn emits (apex_tpu/remat.py)
+    remat_fn = RematPolicy.resolve(remat).wrap(stage_fn)
     if embed_fn is None:
         if not isinstance(microbatches, jnp.ndarray):
             raise ValueError(
@@ -251,10 +254,7 @@ def pipelined_apply(
                     fresh = embed_fn(fresh)
                 x = jnp.where(rank == 0, fresh.astype(act_dtype), x)
             g_stage = c * S + rank
-            fn = stage_fn
-            if remat:
-                fn = jax.checkpoint(stage_fn, static_argnums=())
-            y = fn(chunk_params_at(c), x, g_stage)
+            y = remat_fn(chunk_params_at(c), x, g_stage)
             outs.append(y.astype(act_dtype))
         stacked = jnp.stack(outs)  # (num_chunks, *act_shape)
         # rotate all chunk outputs to the next device
@@ -381,7 +381,7 @@ def _onef1b_fwd_bwd(stage_fn, loss_fn, params, microbatches, remat,
         return jax.tree_util.tree_map(
             lambda p: jax.lax.index_in_dim(p, c, 0, keepdims=False), p_stack)
 
-    f = jax.checkpoint(stage_fn) if remat else stage_fn
+    f = RematPolicy.resolve(remat).wrap(stage_fn)
 
     def mb_at(m):
         return jax.tree_util.tree_map(
@@ -644,7 +644,7 @@ def forward_backward_pipelining_without_interleaving(
     *,
     loss_fn: Callable,
     forward_only: bool = False,
-    remat: bool = False,
+    remat: Any = False,
     grad_scale: Any = 1.0,
     shared_params: Any = None,
     embed_fn: Optional[Callable] = None,
@@ -667,6 +667,11 @@ def forward_backward_pipelining_without_interleaving(
     ``memory_efficient=False`` selects the AD-through-the-tick-scan driver
     (O(M + pp) per-tick residuals; cheaper per step at small M since the
     forward is not recomputed).
+
+    ``remat`` accepts the legacy bool (True == "full"), a mode string, or
+    a :class:`~apex_tpu.remat.RematPolicy` — "selective"/"offload" keep
+    the registry-tagged activations the stage emits resident/offloaded
+    instead of recomputing everything (see ``apex_tpu/remat.py``).
     """
     if memory_efficient and not forward_only:
         return _onef1b_fwd_bwd(
@@ -692,7 +697,7 @@ def forward_backward_pipelining_with_interleaving(
     loss_fn: Callable,
     num_model_chunks: int,
     forward_only: bool = False,
-    remat: bool = False,
+    remat: Any = False,
     grad_scale: Any = 1.0,
     shared_params: Any = None,
     embed_fn: Optional[Callable] = None,

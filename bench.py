@@ -35,6 +35,10 @@ Other configs:
              anchored to 40% MFU — the published llm.c/nanoGPT-class
              utilization for GPT-2-124M-scale A100 training — over this
              chip's peak, using the compiled step's exact FLOP count;
+  remat    — GPT-small train step swept over the activation-remat
+             policies (none|selective|full|offload, apex_tpu/remat.py):
+             ``gpt_remat_<policy>_step_ms`` + ``_temp_bytes`` trace the
+             memory/compute frontier (docs/PERF.md "Remat & HBM");
   flash    — flash-attention seq-4096 fwd+bwd vs XLA attention;
   dp_ovl   — gradient-accumulation window + FusedAdam on the full DP
              mesh, bucketed end-of-window sync vs monolithic per-leaf
@@ -73,7 +77,21 @@ A100_AMP_RN50_IMGS_PER_SEC = 2470.0  # per-chip baseline (see docstring)
 # source of truth for peak-flops numbers. Imported after the compile-cache
 # config above (import triggers no backend use, but keep the config first).
 from apex_tpu.observability.costs import (  # noqa: E402
-    flops_budget, peak_flops as _peak_flops)
+    flops_budget, memory_budget as _memory_budget,
+    peak_flops as _peak_flops)
+
+
+def _mem_extra(compiled) -> dict:
+    """``temp_bytes``/``peak_hbm_bytes`` extras for a bench line, from the
+    compiled step's memory analysis — {} when the backend reports none, so
+    emitted lines never carry fabricated zeros. Every ``bench_gpt_*``
+    entry records these so the perf trajectory tracks memory next to
+    step_ms."""
+    budget = _memory_budget(compiled)
+    if budget is None:
+        return {}
+    return {"temp_bytes": int(budget["temp_bytes"]),
+            "peak_hbm_bytes": int(budget["peak_hbm_bytes"])}
 
 
 def _sync(out) -> None:
@@ -310,18 +328,23 @@ def bench_optimizer():
           leaves512_per_leaf_ms=many_leaf_ms)
 
 
-def bench_gpt(iters=20, warmup=3):
-    """BASELINE config 5: GPT-small train step on one chip — times the
-    Mosaic-compiled flash-attention kernels end to end (fwd+bwd), FusedAdam,
-    dynamic loss scaling."""
+def _gpt_train_step(batch=8, seq=1024, hidden=768, layers=12, heads=12,
+                    vocab=32768, remat_policy=None):
+    """The canonical config-5 GPT-small train step (flash attention,
+    FusedAdam, dynamic loss scaling, donated buffers), AOT-compiled.
+    Shared by :func:`bench_gpt` (the baseline row) and every
+    :func:`bench_gpt_remat` leg, so the remat A/B measures exactly the
+    baseline program modulo policy. Returns ``(cfg, args, wrapped,
+    compiled)``: ``wrapped(*args)`` runs one step and threads the donated
+    buffers back as the next call's args (the `_timeit` convention)."""
     from apex_tpu.amp.scaler import DynamicLossScale, all_finite
     from apex_tpu.models import GPTConfig, GPTModel
     from apex_tpu.optimizers import FusedAdam
 
-    batch, seq = 8, 1024
-    cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
-                    num_attention_heads=12, max_position_embeddings=seq,
-                    compute_dtype=jnp.bfloat16)
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                    num_layers=layers, num_attention_heads=heads,
+                    max_position_embeddings=seq,
+                    compute_dtype=jnp.bfloat16, remat_policy=remat_policy)
     model = GPTModel(cfg)
     params = model.init(jax.random.PRNGKey(0))
     opt = FusedAdam(lr=1e-4)
@@ -329,7 +352,7 @@ def bench_gpt(iters=20, warmup=3):
     scaler = DynamicLossScale(init_scale=2.0 ** 12)
     ls = scaler.init()
     tokens = jnp.asarray(
-        np.random.RandomState(0).randint(0, 32768, (batch, seq)))
+        np.random.RandomState(0).randint(0, vocab, (batch, seq)))
 
     @(lambda f: jax.jit(f, donate_argnums=(0, 1, 2)))
     def step(params, opt_state, ls, tokens):
@@ -349,7 +372,17 @@ def bench_gpt(iters=20, warmup=3):
         params, opt_state, ls = compiled(params, opt_state, ls, tokens)
         return params, opt_state, ls, tokens
 
-    times = _timeit(wrapped, (params, opt_state, ls, tokens), iters, warmup)
+    return cfg, (params, opt_state, ls, tokens), wrapped, compiled
+
+
+def bench_gpt(iters=20, warmup=3):
+    """BASELINE config 5: GPT-small train step on one chip — times the
+    Mosaic-compiled flash-attention kernels end to end (fwd+bwd), FusedAdam,
+    dynamic loss scaling."""
+    batch, seq = 8, 1024
+    cfg, args, wrapped, compiled = _gpt_train_step(batch=batch, seq=seq)
+    params = args[0]
+    times = _timeit(wrapped, args, iters, warmup)
     tok_per_sec = batch * seq / float(np.mean(times))
 
     # anchor: 40% MFU — the published llm.c/nanoGPT-class utilization for
@@ -372,7 +405,68 @@ def bench_gpt(iters=20, warmup=3):
           mfu=round(float(mfu), 4),
           step_ms=round(float(np.mean(times) * 1e3), 3),
           std_ms=round(float(np.std(times) * 1e3), 3),
-          batch=batch, seq=seq)
+          batch=batch, seq=seq, **_mem_extra(compiled))
+
+
+def bench_gpt_remat(iters=10, warmup=2, batch=8, seq=1024, hidden=768,
+                    layers=12, heads=12, vocab=32768):
+    """Activation-remat memory/compute frontier A/B: the BASELINE config-5
+    GPT-small train step swept over the four
+    :class:`~apex_tpu.remat.RematPolicy` modes in one session — same
+    shapes, same data, fresh params per leg, so the deltas isolate the
+    policy. Per policy two lines ride BENCH_*.json:
+
+    - ``gpt_remat_<policy>_step_ms`` (vs_baseline = none_ms/policy_ms,
+      < 1 means the policy pays recompute FLOPs);
+    - ``gpt_remat_<policy>_temp_bytes`` (vs_baseline =
+      policy_temp/none_temp, the fraction of the activation working set
+      kept resident).
+
+    Every leg is built by :func:`_gpt_train_step` — the same constructor
+    as the ``gpt_small_train_tokens_per_sec`` baseline row — so the sweep
+    cannot drift from the program the baseline measures.
+
+    Expected/asserted-in-tests ordering: temp_bytes none > selective >
+    full — selective keeps only the registry-tagged GEMM/flash outputs,
+    full keeps only the scan carry. ``offload`` compiles everywhere but
+    its byte movement only means something where pinned_host is a real
+    second memory space (TPU); read its step_ms there
+    (docs/PERF.md "Remat & HBM")."""
+    def measure(policy):
+        _cfg, args, wrapped, compiled = _gpt_train_step(
+            batch=batch, seq=seq, hidden=hidden, layers=layers,
+            heads=heads, vocab=vocab, remat_policy=policy)
+        mem = _mem_extra(compiled)
+        times = _timeit(wrapped, args, iters, warmup)
+        return float(np.mean(times) * 1e3), float(np.std(times) * 1e3), mem
+
+    results = {}
+    for policy in ("none", "selective", "full", "offload"):
+        try:
+            results[policy] = measure(policy)
+        except Exception as e:  # one leg must not sink the sweep
+            results[policy] = e
+    base = results.get("none")
+    base_ms = base[0] if isinstance(base, tuple) else None
+    base_temp = (base[2].get("temp_bytes")
+                 if isinstance(base, tuple) else None)
+    for policy, r in results.items():
+        if isinstance(r, Exception):
+            _emit(f"gpt_remat_{policy}_step_ms", -1.0, "error", None,
+                  error=str(r))
+            continue
+        ms, std, mem = r
+        _emit(f"gpt_remat_{policy}_step_ms", ms, "ms",
+              None if (base_ms is None or policy == "none")
+              else base_ms / ms,
+              std_ms=round(std, 3), batch=batch, seq=seq, iters=iters,
+              **mem)
+        if "temp_bytes" in mem:
+            _emit(f"gpt_remat_{policy}_temp_bytes", mem["temp_bytes"],
+                  "bytes",
+                  None if (not base_temp or policy == "none")
+                  else mem["temp_bytes"] / base_temp,
+                  peak_hbm_bytes=mem.get("peak_hbm_bytes"))
 
 
 def bench_gpt_sp_overlap(iters=10, warmup=2, batch=8, seq=1024,
@@ -434,23 +528,27 @@ def bench_gpt_sp_overlap(iters=10, warmup=2, batch=8, seq=1024,
                 new_p, loss = smapped(params, tokens)
                 return new_p, loss, tokens
 
-            def wrapped(params, loss, tokens):
-                return step(params, tokens)
-
             # fresh param buffers per variant: the donated originals are
-            # consumed by the first call
+            # consumed by the first call. AOT-compiled so the memory plan
+            # (temp_bytes) is recorded alongside the timing.
             p0 = jax.tree_util.tree_map(jnp.copy, params)
+            compiled = step.lower(p0, tokens).compile()
+
+            def wrapped(params, loss, tokens):
+                return compiled(params, tokens)
+
             times = _timeit(wrapped, (p0, jnp.float32(0.0), tokens),
                             iters, warmup)
-            return batch * seq / float(np.mean(times)), times
+            return (batch * seq / float(np.mean(times)), times,
+                    _mem_extra(compiled))
 
-        fused_tps, _ = measure(False)
-        overlap_tps, times = measure(True)
+        fused_tps, _, _ = measure(False)
+        overlap_tps, times, mem = measure(True)
         _emit("gpt_sp_overlap_tokens_per_sec", overlap_tps, "tokens/sec",
               overlap_tps / fused_tps,
               fused_tps=round(fused_tps, 2), tp=2, batch=batch, seq=seq,
               step_ms=round(float(np.mean(times) * 1e3), 3),
-              std_ms=round(float(np.std(times) * 1e3), 3))
+              std_ms=round(float(np.std(times) * 1e3), 3), **mem)
     finally:
         parallel_state.destroy_model_parallel()
 
@@ -592,11 +690,14 @@ def main():
     if not headline_only:
         budget_s = 420.0
         t0 = time.perf_counter()
-        # sp_ovl runs LAST of the configs: its two GPT TP=2 compiles must
-        # not starve the budget of the baseline-tracked metrics above it
+        # the multi-compile configs run LAST, newest first to be starved:
+        # sp_ovl (two GPT TP=2 compiles) after the longer-tracked configs
+        # above it, and remat (FOUR GPT-small train-step compiles, the
+        # heaviest config) dead last so a tight budget drops the newest
+        # metrics, never the established baseline rows
         for fn in (bench_layernorm, bench_optimizer, bench_gpt,
                    bench_flash_long, bench_dp_accumulate_overlap,
-                   bench_gpt_sp_overlap):
+                   bench_gpt_sp_overlap, bench_gpt_remat):
             if time.perf_counter() - t0 > budget_s:
                 _emit(fn.__name__, -1.0, "skipped", None,
                       error="config budget exhausted; headline protected")

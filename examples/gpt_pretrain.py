@@ -44,6 +44,13 @@ def main(argv=None):
                          "through the bucketed overlap engine in B "
                          "fixed-size flat fp32 buckets (docs/PERF.md "
                          "'DP overlap + ZeRO'; default: unbucketed)")
+    ap.add_argument("--remat-policy", default=None,
+                    choices=["none", "full", "selective", "offload"],
+                    help="activation rematerialization policy "
+                         "(apex_tpu/remat.py): selective keeps the "
+                         "registry-tagged GEMM/flash outputs resident "
+                         "and recomputes only the LN/gelu tier "
+                         "(docs/PERF.md 'Remat & HBM')")
     ap.add_argument("--sequence-parallel", action="store_true",
                     help="Megatron-LM sequence parallelism (tp > 1, "
                          "pp == 1, VMA jax — the trainer refuses on the "
@@ -65,6 +72,7 @@ def main(argv=None):
                           num_layers=args.layers_per_stage * pp,
                           num_attention_heads=4,
                           max_position_embeddings=seq,
+                          remat_policy=args.remat_policy,
                           sequence_parallel=args.sequence_parallel,
                           tp_comm_overlap=args.tp_comm_overlap),
         parallel=ParallelConfig(tensor_model_parallel_size=tp,

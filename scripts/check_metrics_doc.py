@@ -2,11 +2,14 @@
 """Static check: every in-graph metric recorded in source is documented.
 
 The per-step metric families (``health/*``, ``tp/*``, ``amp/*``,
-``ddp/*``, ``pipeline/*``, ``optim/*``, ``zero/*``) are a public contract — dashboards
+``ddp/*``, ``pipeline/*``, ``optim/*``, ``zero/*``, ``mem/*``) are a
+public contract — dashboards
 and the crash-dump post-mortem workflow key on the names — and the
 contract lives in the docs/OBSERVABILITY.md table. A ``record()`` call
 added without a doc row silently grows an undocumented surface; this
-script AST-walks the package for ``record(...)`` call sites, extracts the
+script AST-walks the package for ``record(...)`` call sites — and
+``gauge(...)`` call sites, the host-registry half the ``mem/*`` family
+lives on — extracts the
 metric-name first argument (plain string literals, and f-strings whose
 formatted fields normalize to a ``<>`` placeholder — ``f"health/{name}/l2"``
 checks as ``health/<>/l2``), and requires each name in a checked family to
@@ -35,7 +38,12 @@ DOC = os.path.join("docs", "OBSERVABILITY.md")
 # metric families under the documentation contract; names outside these
 # prefixes (host registry internals, ad-hoc example metrics) are exempt
 PREFIXES = ("health/", "tp/", "amp/", "ddp/", "pipeline/", "optim/",
-            "zero/")
+            "zero/", "mem/")
+
+# callees whose literal first argument is a metric name: in-graph
+# ``ingraph.record(...)`` and host-registry ``registry.gauge(...)`` (the
+# mem/* family is static per compile, so it rides gauges, not records)
+CALLEES = ("record", "gauge")
 
 _PLACEHOLDER = re.compile(r"<[^<>`]*>")
 
@@ -64,8 +72,9 @@ def _literal_name(node) -> str | None:
 
 
 def recorded_names(repo: str = REPO):
-    """Yield ``(relpath, lineno, name)`` for every ``record(...)`` metric
-    name in the package that falls under a checked prefix."""
+    """Yield ``(relpath, lineno, name)`` for every ``record(...)`` /
+    ``gauge(...)`` metric name in the package that falls under a checked
+    prefix."""
     pkg_root = os.path.join(repo, PACKAGE)
     for dirpath, _dirnames, filenames in sorted(os.walk(pkg_root)):
         for fname in sorted(filenames):
@@ -85,7 +94,7 @@ def recorded_names(repo: str = REPO):
                 callee = (func.id if isinstance(func, ast.Name)
                           else func.attr if isinstance(func, ast.Attribute)
                           else None)
-                if callee != "record":
+                if callee not in CALLEES:
                     continue
                 name = _literal_name(node.args[0])
                 if name is not None and _norm(name).startswith(PREFIXES):

@@ -387,6 +387,39 @@ class TestStepReporter:
         # the gauge also lands in the registry for later snapshots
         assert rep.registry.snapshot()["perf/mfu"] == emitted[1]["perf/mfu"]
 
+    def test_memory_budget_gauges(self):
+        """attach_memory_budget sets the mem/* gauge family — from a
+        budget dict or straight from a compiled executable — and a
+        None-budget backend leaves the gauges unset (no fabricated
+        zeros)."""
+        rep = obs.StepReporter([], registry=obs.MetricsRegistry())
+        budget = {"argument_bytes": 100, "output_bytes": 10,
+                  "temp_bytes": 50, "alias_bytes": 0,
+                  "generated_code_bytes": 1, "host_temp_bytes": 0,
+                  "peak_hbm_bytes": 161}
+        assert rep.attach_memory_budget(budget) is rep
+        snap = rep.registry.snapshot()
+        assert snap["mem/peak_hbm_bytes"] == 161.0
+        assert snap["mem/temp_bytes"] == 50.0
+        assert snap["mem/argument_bytes"] == 100.0
+        assert snap["mem/output_bytes"] == 10.0
+        assert snap["mem/host_temp_bytes"] == 0.0
+
+        # straight from a compiled executable (skip silently if the
+        # backend reports no analysis — then nothing may be set)
+        rep2 = obs.StepReporter([], registry=obs.MetricsRegistry())
+        compiled = jax.jit(lambda x: jnp.sum(x * x)).lower(
+            jnp.ones((32, 32))).compile()
+        rep2.attach_memory_budget(compiled)
+        snap2 = rep2.registry.snapshot()
+        if obs.memory_budget(compiled) is not None:
+            assert snap2["mem/peak_hbm_bytes"] > 0
+        # an analysis-less object must leave the family unset
+        rep3 = obs.StepReporter([], registry=obs.MetricsRegistry())
+        rep3.attach_memory_budget(object())
+        assert not any(k.startswith("mem/")
+                       for k in rep3.registry.snapshot())
+
     def test_null_reporter_default(self):
         obs.detach_reporter()
         rep = obs.get_reporter()
@@ -500,7 +533,26 @@ class TestCosts:
                    if isinstance(node, ast.ImportFrom)
                    and node.module == "apex_tpu.observability.costs"
                    for n in node.names]
-        assert {a.name for a in imports} >= {"flops_budget", "peak_flops"}
+        assert {a.name for a in imports} >= {"flops_budget", "peak_flops",
+                                             "memory_budget"}
+
+    def test_memory_budget_from_compiled(self):
+        """memory_analysis() extraction: real bytes on backends that report
+        (the CPU backend does), None — never a raise — otherwise."""
+        compiled = jax.jit(
+            lambda x, w: jnp.sum(jnp.tanh(x @ w) @ w)).lower(
+            jnp.ones((64, 64)), jnp.ones((64, 64))).compile()
+        budget = obs.memory_budget(compiled)
+        assert obs.memory_budget(object()) is None
+        if budget is None:  # backend without memory analysis
+            return
+        for key in ("argument_bytes", "output_bytes", "temp_bytes",
+                    "alias_bytes", "generated_code_bytes",
+                    "host_temp_bytes", "peak_hbm_bytes"):
+            assert key in budget and budget[key] >= 0, key
+        # two 64x64 fp32 args, and the high-water covers them
+        assert budget["argument_bytes"] == 2 * 64 * 64 * 4
+        assert budget["peak_hbm_bytes"] >= budget["argument_bytes"]
 
 
 # ---------------------------------------------------------------------------
@@ -968,3 +1020,81 @@ class TestCheckMetricsDoc:
         (tmp_path / "apex_tpu").mkdir()
         ok, lines = mod.check(repo=str(tmp_path))
         assert not ok and any("MISSING" in l for l in lines)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-name registry contract (no orphan remat tags)
+# ---------------------------------------------------------------------------
+
+class TestCheckRematNames:
+    def test_script_passes_on_this_tree(self):
+        proc = subprocess.run(
+            [sys.executable, "scripts/check_remat_names.py"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        # the registry families the models emit all show up as checked
+        for name in ("flash_ctx", "flash_lse", "qkv_out", "mlp_fc1_out",
+                     "ln_out"):
+            assert name in proc.stdout, name
+
+    def _mod(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "check_remat_names", "scripts/check_remat_names.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def _registry(self, tmp_path, selective_extra=""):
+        pkg = tmp_path / "apex_tpu"
+        pkg.mkdir(parents=True, exist_ok=True)
+        (pkg / "remat.py").write_text(
+            "CHECKPOINT_NAMES = ('qkv_out', 'ln_out')\n"
+            f"SELECTIVE_SAVE = ('qkv_out',{selective_extra})\n")
+        return pkg
+
+    def test_detects_orphan_tag(self, tmp_path):
+        """A checkpoint_name literal outside the registry is an activation
+        no policy can save — flagged through every tag spelling (raw
+        checkpoint_name, the tag chokepoint, the models' bound _tag)."""
+        mod = self._mod()
+        pkg = self._registry(tmp_path)
+        (pkg / "bad.py").write_text(
+            "from jax.ad_checkpoint import checkpoint_name\n"
+            "def f(self, x):\n"
+            "    x = checkpoint_name(x, 'rogue_act')\n"
+            "    x = self._tag(x, 'another_rogue')\n"
+            "    return self._tag(x, 'qkv_out')\n")
+        ok, lines = mod.check(repo=str(tmp_path))
+        assert not ok
+        orphans = [l for l in lines if l.startswith("ORPHAN")]
+        assert any("rogue_act" in l and "bad.py:3" in l for l in orphans)
+        assert any("another_rogue" in l and "bad.py:4" in l
+                   for l in orphans)
+        assert not any("qkv_out" in l for l in orphans)
+        # the real tree stays clean
+        ok, lines = mod.check()
+        assert ok, "\n".join(lines)
+
+    def test_detects_save_list_outside_registry(self, tmp_path):
+        """SELECTIVE_SAVE must be a registry subset — an entry nobody can
+        tag silently saves nothing."""
+        mod = self._mod()
+        self._registry(tmp_path, selective_extra=" 'phantom',")
+        ok, lines = mod.check(repo=str(tmp_path))
+        assert not ok
+        assert any("phantom" in l and "SELECTIVE_SAVE" in l for l in lines)
+
+    def test_missing_registry_fails(self, tmp_path):
+        mod = self._mod()
+        (tmp_path / "apex_tpu").mkdir()
+        ok, lines = mod.check(repo=str(tmp_path))
+        assert not ok and any("MISSING" in l for l in lines)
+
+    def test_runtime_tag_rejects_orphans_too(self):
+        """The static check's runtime twin: remat.tag refuses unregistered
+        names at trace time."""
+        from apex_tpu import remat
+        with pytest.raises(ValueError, match="CHECKPOINT_NAMES"):
+            remat.tag(jnp.ones(3), "rogue_act")
+        assert set(remat.SELECTIVE_SAVE) <= set(remat.CHECKPOINT_NAMES)
