@@ -78,6 +78,14 @@ class GPTConfig:
     # (tensor_parallel.collective_matmul) so the dependent TP collectives
     # overlap with compute in fwd AND bwd; requires sequence_parallel
     tp_comm_overlap: bool = False
+    # Layer-stack scan unroll factor (lax.scan's ``unroll``): 1 = compact
+    # while loop (O(1) compile in depth), num_layers/True = fully
+    # unrolled. Unrolled programs are what XLA's cost_analysis can count
+    # end to end (a while body is priced once regardless of trip count),
+    # so scripts/attribute_step.py uses True to validate the pyprof
+    # roofline against flops_budget; on TPU, small factors (2-4) can also
+    # buy scheduling overlap across layer boundaries.
+    layer_scan_unroll: Any = 1
     # Dropout (standalone_gpt.py attention/hidden dropout; 0.0 = off so
     # eval-style calls stay deterministic without threading an rng).
     # Semantics under TP follow the reference's RNG stream layout
@@ -223,14 +231,18 @@ class GPTModel:
     # -- blocks -------------------------------------------------------------
 
     def _ln(self, p: dict, x: jnp.ndarray) -> jnp.ndarray:
-        # mixed-dtype rule: bf16 activations, fp32 ln params -> bf16 out
-        out = fused_layer_norm_affine(
-            x, p["weight"].astype(x.dtype), p["bias"].astype(x.dtype),
-            self.cfg.hidden_size, eps=self.cfg.layernorm_epsilon)
+        # mixed-dtype rule: bf16 activations, fp32 ln params -> bf16 out.
+        # The named_scope is a pyprof attribution region
+        # (scripts/check_annotations.py contract).
+        with jax.named_scope("gpt_ln"):
+            out = fused_layer_norm_affine(
+                x, p["weight"].astype(x.dtype), p["bias"].astype(x.dtype),
+                self.cfg.hidden_size, eps=self.cfg.layernorm_epsilon)
         # dropped by the selective policy: recomputing an LN is one fused
         # elementwise pass — the cheap tier selective remat exists to shed
         return self._tag(out, "ln_out")
 
+    @jax.named_scope("gpt_attention")
     def _attention(self, lp: dict, x: jnp.ndarray,
                    attn_seed=None) -> jnp.ndarray:
         cfg = self.cfg
@@ -254,6 +266,7 @@ class GPTModel:
         out, _ = self.proj(lp["proj"], ctx)
         return self._tag(out, "attn_proj_out")
 
+    @jax.named_scope("gpt_mlp")
     def _mlp(self, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
         h, _ = self.fc1(lp["fc1"], x)
         # tagged PRE-gelu: saving the GEMM output costs the same bytes and
@@ -299,6 +312,7 @@ class GPTModel:
 
     # -- forward ------------------------------------------------------------
 
+    @jax.named_scope("gpt_embed")
     def embed(self, params: dict, tokens: jnp.ndarray,
               dropout_rng: Optional[jax.Array] = None) -> jnp.ndarray:
         cfg = self.cfg
@@ -379,7 +393,8 @@ class GPTModel:
             def body(x, lp):
                 return layer_fn(lp, x), None
 
-        x, _ = scan_stable_vma(body, x, xs)
+        x, _ = scan_stable_vma(body, x, xs,
+                               unroll=cfg.layer_scan_unroll)
         x = self._ln(params["final_ln"], x)
         if cfg.sequence_parallel:
             from apex_tpu.transformer.context_parallel import (
@@ -389,6 +404,7 @@ class GPTModel:
                                                      invariant=True)
         return x
 
+    @jax.named_scope("gpt_head_loss")
     def logits(self, params: dict, x: jnp.ndarray) -> jnp.ndarray:
         """Tied output embedding (standalone_gpt.py parallel_lm_logits):
         returns vocab-parallel logits (local shard) when tp>1."""
@@ -414,17 +430,19 @@ class GPTModel:
         (``standalone_gpt.py`` post_language_model_processing).
         ``dropout_rng`` enables train-mode dropout."""
         logits = self(params, tokens, dropout_rng)
-        if self.cfg.tensor_model_parallel_size > 1:
-            per_tok = vocab_parallel_cross_entropy(logits, targets)
-        else:
-            per_tok = softmax_cross_entropy_loss(
-                logits.reshape(-1, logits.shape[-1]), targets.reshape(-1),
-                padding_idx=None, half_to_float=True
-            ).reshape(targets.shape)
-        if loss_mask is not None:
-            return jnp.sum(per_tok * loss_mask) / jnp.maximum(
-                jnp.sum(loss_mask), 1.0)
-        return jnp.mean(per_tok)
+        with jax.named_scope("gpt_head_loss"):
+            if self.cfg.tensor_model_parallel_size > 1:
+                per_tok = vocab_parallel_cross_entropy(logits, targets)
+            else:
+                per_tok = softmax_cross_entropy_loss(
+                    logits.reshape(-1, logits.shape[-1]),
+                    targets.reshape(-1),
+                    padding_idx=None, half_to_float=True
+                ).reshape(targets.shape)
+            if loss_mask is not None:
+                return jnp.sum(per_tok * loss_mask) / jnp.maximum(
+                    jnp.sum(loss_mask), 1.0)
+            return jnp.mean(per_tok)
 
     def sp_grad_sync(self, grads: dict) -> dict:
         """Megatron-LM allreduces the grads of ``sequence_parallel``-marked
@@ -466,7 +484,8 @@ class GPTModel:
             def body(x, lp):
                 return layer_fn(lp, x), None
 
-            x, _ = scan_stable_vma(body, x, stage_params)
+            x, _ = scan_stable_vma(body, x, stage_params,
+                                   unroll=self.cfg.layer_scan_unroll)
             return x
 
         def split_params(params: dict):

@@ -219,16 +219,24 @@ class ResNet50:
         cfg = self.cfg
         x = x.astype(cfg.compute_dtype)
         new_state = {"stem": {}}
-        h = self._stem_conv(params["stem"]["conv"], x)
-        h, new_state["stem"]["bn"] = _bn_apply(
-            cfg, params["stem"]["bn"], state["stem"]["bn"], h, training)
-        h = jax.lax.reduce_window(
-            h, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
-            [(0, 0), (1, 1), (1, 1), (0, 0)])
-        for i, stage in enumerate(self.blocks):
-            for j, blk in enumerate(stage):
-                h, new_state[f"b{i}_{j}"] = blk(
-                    params[f"b{i}_{j}"], state[f"b{i}_{j}"], h, training)
-        h = jnp.mean(h.astype(jnp.float32), axis=(1, 2))
-        w = params["fc"]["weight"].astype(jnp.float32)
-        return h @ w.T + params["fc"]["bias"], new_state
+        # the named_scope blocks are pyprof attribution regions
+        # (scripts/check_annotations.py contract): stem conv+pool,
+        # bottleneck body, pooled head — the granularity the per-region
+        # roofline reports at
+        with jax.named_scope("rn50_stem"):
+            h = self._stem_conv(params["stem"]["conv"], x)
+            h, new_state["stem"]["bn"] = _bn_apply(
+                cfg, params["stem"]["bn"], state["stem"]["bn"], h, training)
+            h = jax.lax.reduce_window(
+                h, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+                [(0, 0), (1, 1), (1, 1), (0, 0)])
+        with jax.named_scope("rn50_body"):
+            for i, stage in enumerate(self.blocks):
+                for j, blk in enumerate(stage):
+                    h, new_state[f"b{i}_{j}"] = blk(
+                        params[f"b{i}_{j}"], state[f"b{i}_{j}"], h,
+                        training)
+        with jax.named_scope("rn50_head"):
+            h = jnp.mean(h.astype(jnp.float32), axis=(1, 2))
+            w = params["fc"]["weight"].astype(jnp.float32)
+            return h @ w.T + params["fc"]["bias"], new_state

@@ -22,10 +22,14 @@ as a live gauge. Both now read from here:
 
 from __future__ import annotations
 
+import dataclasses
+import math
+import os
 from typing import Dict, Optional
 
 __all__ = ["PEAK_BF16_FLOPS", "DEFAULT_PEAK_FLOPS", "peak_flops",
-           "flops_budget", "memory_budget", "mfu"]
+           "DeviceSpec", "DEVICE_SPECS", "DEFAULT_DEVICE_SPEC",
+           "device_spec", "flops_budget", "memory_budget", "mfu"]
 
 # peak dense bf16 TFLOP/s per chip by device kind (public spec sheets)
 PEAK_BF16_FLOPS = {
@@ -55,6 +59,87 @@ def peak_flops(device=None) -> float:
         if kind.startswith(prefix):
             return value
     return DEFAULT_PEAK_FLOPS
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceSpec:
+    """Roofline corners of one chip: peak dense bf16 FLOP/s, HBM bandwidth
+    and per-link ICI bandwidth. The numbers the pyprof roofline evaluator
+    (:mod:`apex_tpu.pyprof.model`) divides modeled FLOPs/bytes by."""
+    name: str
+    peak_flops: float   # dense bf16 FLOP/s per chip
+    hbm_gbps: float     # HBM bandwidth, GB/s per chip
+    ici_gbps: float     # ICI bandwidth, GB/s per link per direction
+
+    def compute_ms(self, flops: float) -> float:
+        return flops / self.peak_flops * 1e3
+
+    def hbm_ms(self, traffic_bytes: float) -> float:
+        return traffic_bytes / (self.hbm_gbps * 1e9) * 1e3
+
+    def comm_ms(self, wire_bytes: float) -> float:
+        return wire_bytes / (self.ici_gbps * 1e9) * 1e3
+
+
+# HBM/ICI companions to PEAK_BF16_FLOPS (public spec-sheet numbers; ICI
+# is per link per direction — the ring models in pyprof serialize hops
+# over one link, the worst-case topology). Env-overridable via
+# APEX_TPU_PEAK_FLOPS / APEX_TPU_HBM_GBPS / APEX_TPU_ICI_GBPS, the escape
+# hatch for chips the table has not learned yet (and for calibrating the
+# roofline against a measured bandwidth instead of the datasheet).
+DEVICE_SPECS = {
+    "TPU v4": DeviceSpec("TPU v4", PEAK_BF16_FLOPS["TPU v4"], 1228.0, 50.0),
+    "TPU v5 lite": DeviceSpec("TPU v5e", PEAK_BF16_FLOPS["TPU v5e"],
+                              819.0, 50.0),
+    "TPU v5e": DeviceSpec("TPU v5e", PEAK_BF16_FLOPS["TPU v5e"],
+                          819.0, 50.0),
+    "TPU v5": DeviceSpec("TPU v5p", PEAK_BF16_FLOPS["TPU v5p"],
+                         2765.0, 100.0),
+    "TPU v5p": DeviceSpec("TPU v5p", PEAK_BF16_FLOPS["TPU v5p"],
+                          2765.0, 100.0),
+    "TPU v6 lite": DeviceSpec("TPU v6e", PEAK_BF16_FLOPS["TPU v6e"],
+                              1640.0, 100.0),
+    "TPU v6e": DeviceSpec("TPU v6e", PEAK_BF16_FLOPS["TPU v6e"],
+                          1640.0, 100.0),
+}
+
+# CPU test hosts and unknown chips: v5e-class corners, same rationale as
+# DEFAULT_PEAK_FLOPS (conservative for utilization claims; on CPU the
+# modeled milliseconds are structural, not predictive — the regions,
+# ratios and byte counts are what the tests pin down)
+DEFAULT_DEVICE_SPEC = DeviceSpec("unknown (v5e-class assumed)",
+                                 DEFAULT_PEAK_FLOPS, 819.0, 50.0)
+
+
+def device_spec(device=None) -> DeviceSpec:
+    """The :class:`DeviceSpec` of ``device`` (default: first visible
+    device), matched by ``device_kind`` prefix; falls back to
+    :data:`DEFAULT_DEVICE_SPEC`. ``APEX_TPU_PEAK_FLOPS`` (FLOP/s),
+    ``APEX_TPU_HBM_GBPS`` and ``APEX_TPU_ICI_GBPS`` (GB/s) override the
+    matched table entry field-by-field."""
+    if device is None:
+        import jax
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "")
+    spec = DEFAULT_DEVICE_SPEC
+    for prefix, value in DEVICE_SPECS.items():
+        if kind.startswith(prefix):
+            spec = value
+            break
+    overrides = {}
+    for env, field in (("APEX_TPU_PEAK_FLOPS", "peak_flops"),
+                       ("APEX_TPU_HBM_GBPS", "hbm_gbps"),
+                       ("APEX_TPU_ICI_GBPS", "ici_gbps")):
+        raw = os.environ.get(env)
+        if raw:
+            value = float(raw)
+            if value <= 0.0:
+                raise ValueError(f"{env} must be positive, got {raw!r}")
+            overrides[field] = value
+    if overrides:
+        spec = dataclasses.replace(spec, name=spec.name + " (env-tuned)",
+                                   **overrides)
+    return spec
 
 
 def flops_budget(compiled) -> Optional[float]:
@@ -127,9 +212,18 @@ def memory_budget(compiled) -> Optional[Dict[str, int]]:
 def mfu(flops_per_step: float, step_time_s: float,
         peak: Optional[float] = None) -> float:
     """Model-flops-utilization: ``flops_per_step / step_time_s / peak``
-    (``peak`` defaults to :func:`peak_flops` of the first device)."""
+    (``peak`` defaults to :func:`peak_flops` of the first device).
+
+    A non-positive ``step_time_s`` or ``peak`` returns ``NaN`` instead of
+    raising: the first-report wall-time delta in a tight loop can
+    legitimately be ~0 on a fast host (two ``perf_counter`` reads between
+    cached dispatches), and an exception or ``inf``/``ZeroDivisionError``
+    mid-``report()`` would kill the training loop over a telemetry
+    artifact. Consumers that want a hard failure should validate inputs
+    at configuration time (``StepReporter.attach_flops_budget`` does).
+    """
     if peak is None:
         peak = peak_flops()
     if step_time_s <= 0.0 or peak <= 0.0:
-        raise ValueError("step_time_s and peak must be positive")
+        return math.nan
     return flops_per_step / step_time_s / peak

@@ -20,6 +20,7 @@ zero cost; :func:`attach_reporter` swaps the real one in.
 
 from __future__ import annotations
 
+import math
 import time
 from typing import Callable, Dict, Iterable, Optional, Sequence
 
@@ -131,6 +132,26 @@ class StepReporter:
         reg.gauge("mem/host_temp_bytes").set(budget["host_temp_bytes"])
         return self
 
+    def attach_attribution(self, report) -> "StepReporter":
+        """Set the ``perf/*`` attribution gauges from an
+        :class:`~apex_tpu.pyprof.attribute.AttributionReport` —
+        ``perf/modeled_step_ms`` (the roofline lower bound of the step),
+        ``perf/comm_exposed_ms`` (modeled communication the measured step
+        failed to hide under compute) and ``perf/overlap_efficiency``
+        (share of modeled comm successfully hidden, unset on comm-free
+        programs). Like the memory budget these are per-compile
+        constants: attach once after AOT compile + a measured step and
+        every snapshot carries the step's attribution next to its live
+        metrics. Returns self for chaining."""
+        reg = self.registry
+        reg.gauge("perf/modeled_step_ms").set(report.modeled_step_ms)
+        if report.comm_exposed_ms is not None:
+            reg.gauge("perf/comm_exposed_ms").set(report.comm_exposed_ms)
+        if report.overlap_efficiency is not None:
+            reg.gauge("perf/overlap_efficiency").set(
+                report.overlap_efficiency)
+        return self
+
     def _update_mfu(self, step: int) -> None:
         """Set the perf/mfu gauge from the wall time since the previous
         report; it reaches the payload through the registry snapshot."""
@@ -144,8 +165,12 @@ class StepReporter:
         if d_steps <= 0 or dt <= 0.0:
             return
         from apex_tpu.observability.costs import mfu
-        self.registry.gauge("perf/mfu").set(
-            mfu(self._flops_per_step * d_steps, dt, self._peak_flops))
+        value = mfu(self._flops_per_step * d_steps, dt, self._peak_flops)
+        # a ~0 wall delta (fast host, two reports inside one perf_counter
+        # tick) yields NaN/inf — leave the gauge unset for this report
+        # rather than emitting a fabricated utilization
+        if math.isfinite(value):
+            self.registry.gauge("perf/mfu").set(value)
 
     @staticmethod
     def _metrics_payload(metrics) -> Dict[str, float]:

@@ -78,8 +78,12 @@ class OptimizerBase:
     def _step(self, grads: Any, state: Any, params: Any, **kw) -> Tuple[Any, Any]:
         raise NotImplementedError  # pragma: no cover - abstract
 
+    @jax.named_scope("optimizer_step")
     def step(self, grads: Any, state: Any, params: Any,
              grads_finite: Optional[jnp.ndarray] = None, **kw) -> Tuple[Any, Any]:
+        # the named_scope is a pyprof attribution region
+        # (scripts/check_annotations.py contract) — the whole
+        # update+overflow-select epilogue prices as one bucket.
         # thunked: the norm reduction is only added to the program when a
         # telemetry collector is active
         _metrics.record("optim/grad_norm",

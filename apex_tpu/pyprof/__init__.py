@@ -1,26 +1,70 @@
-"""Deprecated stub (SURVEY §7.7): pyprof's NVTX profiling pipeline.
+"""pyprof reborn (SURVEY §7.7): annotate -> trace -> attribute for TPU.
 
-The reference (``reference:apex/pyprof/``, deprecated upstream) implements
-annotate (NVTX monkey-patch) -> trace (nvprof) -> attribute (per-kernel
-FLOP/byte analysis). The TPU-native workflow lives in
-:mod:`apex_tpu.utils.timers`:
+The reference (``reference:apex/pyprof/``, deprecated upstream)
+implements annotate (NVTX monkey-patch) -> trace (nvprof) -> attribute
+(per-kernel FLOP/byte analysis). This package is the TPU-native rebuild
+of the same three stages for JAX/XLA programs:
 
-- annotate: ``jax.named_scope`` (hot paths in this library are
-  pre-annotated — DDP allreduce, SyncBN stats, pipeline tick, flash
-  attention);
-- trace: :func:`apex_tpu.utils.timers.profile_trace` (``jax.profiler``);
-- attribute: the trace viewer (tensorboard/xprof), or
-  ``jit(f).lower(...).compile().cost_analysis()`` for static FLOP/byte
-  budgets per program.
+- **annotate** — :func:`annotate` is ``jax.named_scope`` (names reach
+  HLO op metadata, jaxpr equations, and captured profiles); the
+  library's hot paths are pre-annotated with the region vocabulary in
+  :data:`~apex_tpu.pyprof.model.DEFAULT_REGIONS`, statically enforced by
+  ``scripts/check_annotations.py``;
+- **trace** — ``apex_tpu.utils.timers.profile_trace`` (``jax.profiler``)
+  for device traces, or the host-side span buffer in
+  :mod:`apex_tpu.observability.trace`; either joins back via
+  :func:`~apex_tpu.pyprof.attribute.region_times_from_trace_dir` /
+  :func:`~apex_tpu.pyprof.attribute.region_times_from_spans`;
+- **attribute** — :func:`~apex_tpu.pyprof.model.model_program` prices
+  every region against the chip's roofline
+  (:class:`~apex_tpu.observability.costs.DeviceSpec`), and
+  :func:`~apex_tpu.pyprof.attribute.attribute` joins the model with a
+  measured step into an :class:`~apex_tpu.pyprof.attribute.
+  AttributionReport` (markdown table, JSONL, and the
+  ``perf/modeled_step_ms`` / ``perf/comm_exposed_ms`` /
+  ``perf/overlap_efficiency`` gauges via
+  ``StepReporter.attach_attribution``).
 
-Any attribute access raises with this guidance.
+Entry points: ``scripts/attribute_step.py --model gpt|rn50`` for the
+bench workloads, ``GPTHybridTrainer.attribution_report`` for the hybrid
+trainer's own jitted step.
+
+The NVTX-era module names (``pyprof.nvtx``, ``pyprof.prof``,
+``pyprof.parse``) remain importable attributes that raise with a
+migration pointer — the contract the old stub documented.
 """
 
-_MSG = ("apex_tpu.pyprof is a documented stub: use apex_tpu.utils.timers "
-        "(profile_trace + jax.named_scope + cost_analysis) — see "
-        "apex_tpu/pyprof/__init__.py for the annotate->trace->attribute "
-        "mapping.")
+from jax import named_scope as annotate  # noqa: F401 — the annotate stage
+
+from apex_tpu.pyprof.model import (  # noqa: F401
+    DEFAULT_REGIONS, ProgramCost, RegionCost, UNATTRIBUTED, jaxpr_of,
+    model_program)
+from apex_tpu.pyprof.attribute import (  # noqa: F401
+    AttributionReport, RegionAttribution, attribute,
+    region_times_from_spans, region_times_from_trace_dir)
+
+__all__ = ["annotate", "attribute", "model_program", "jaxpr_of",
+           "AttributionReport", "RegionAttribution", "ProgramCost",
+           "RegionCost", "DEFAULT_REGIONS", "UNATTRIBUTED",
+           "region_times_from_spans", "region_times_from_trace_dir"]
+
+# NVTX-era surface -> migration pointers (annotate -> trace -> attribute)
+_DEPRECATED = {
+    "nvtx": ("apex_tpu.pyprof.annotate (jax.named_scope) — hot paths are "
+             "pre-annotated; profile_trace captures them"),
+    "prof": ("apex_tpu.pyprof.attribute / model_program — the per-region "
+             "FLOP/byte roofline attribution"),
+    "parse": ("apex_tpu.pyprof.region_times_from_trace_dir — joins a "
+              "jax.profiler capture back onto the annotated regions"),
+}
 
 
 def __getattr__(name):
-    raise NotImplementedError(_MSG)
+    if name in _DEPRECATED:
+        raise NotImplementedError(
+            f"apex_tpu.pyprof.{name} is the deprecated NVTX-era surface; "
+            f"use {_DEPRECATED[name]}. The TPU-native pipeline is "
+            "annotate (jax.named_scope) -> trace "
+            "(apex_tpu.utils.timers.profile_trace) -> attribute "
+            "(apex_tpu.pyprof.attribute).")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

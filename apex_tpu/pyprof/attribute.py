@@ -1,0 +1,294 @@
+"""Trace attribution: join the roofline model against a measured step.
+
+The reference pyprof's ``prof`` stage joins the nvprof kernel trace
+against its analytic model and prints per-kernel utilization
+(``reference:apex/pyprof/prof/output.py``). Here the join runs at region
+granularity: :func:`attribute` takes the program (for the
+:func:`~apex_tpu.pyprof.model.model_program` roofline) plus a measured
+step time — and, when available, per-region wall times from drained
+:mod:`~apex_tpu.observability.trace` spans or a ``jax.profiler`` trace
+directory — and produces an :class:`AttributionReport`:
+
+- per region: modeled FLOPs/bytes, roofline milliseconds, the binding
+  resource, the region's share of the step, and ``comm_exposed_ms`` —
+  the measured time the region spent beyond max(modeled compute, modeled
+  HBM), capped at the region's modeled comm time: communication the
+  schedule failed to hide under compute;
+- whole step: ``modeled_step_ms`` (the lower bound the tp/dp overlap
+  machinery is tuned against), ``comm_exposed_ms`` (sum of the region
+  exposures) and ``overlap_efficiency`` = 1 - exposed/modeled-comm (1.0
+  = every modeled byte rode under compute; None on comm-free programs).
+
+Without per-region walls the measured step is apportioned by modeled
+share (``measured_source="scaled"``) — exposure then reads as each
+region's share of the measured-vs-modeled gap, still capped by its
+modeled comm. With walls (``measured_source="trace"``) the exposure is a
+direct measurement. ``StepReporter.attach_attribution`` lifts the three
+whole-step numbers into the ``perf/*`` gauge family.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import gzip
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+from apex_tpu.observability.costs import DeviceSpec, flops_budget
+from apex_tpu.pyprof.model import (DEFAULT_REGIONS, UNATTRIBUTED,
+                                   ProgramCost, _region_of, model_program)
+
+__all__ = ["RegionAttribution", "AttributionReport", "attribute",
+           "region_times_from_spans", "region_times_from_trace_dir"]
+
+
+@dataclasses.dataclass
+class RegionAttribution:
+    name: str
+    flops: float
+    comm_bytes: float
+    hbm_bytes: float
+    compute_ms: float
+    hbm_ms: float
+    comm_ms: float
+    modeled_ms: float
+    bound: str
+    share: float                      # of the whole-step modeled time
+    measured_ms: Optional[float] = None
+    comm_exposed_ms: Optional[float] = None
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class AttributionReport:
+    regions: List[RegionAttribution]
+    spec: DeviceSpec
+    modeled_step_ms: float
+    step_time_ms: Optional[float]
+    comm_exposed_ms: Optional[float]
+    overlap_efficiency: Optional[float]
+    flops: float
+    xla_flops: Optional[float]        # flops_budget(compiled) when given
+    measured_source: str              # "trace" | "scaled" | "none"
+    notes: List[str]
+
+    def as_dict(self) -> Dict[str, Any]:
+        out = dataclasses.asdict(self)
+        out["spec"] = dataclasses.asdict(self.spec)
+        return out
+
+    def markdown(self) -> str:
+        """The per-region attribution table, GitHub-markdown."""
+        head = ("| region | flops | comm MB | hbm MB | modeled ms | bound "
+                "| share | measured ms | comm exposed ms |")
+        rule = "|---|---|---|---|---|---|---|---|---|"
+        rows = [head, rule]
+        for r in self.regions:
+            rows.append(
+                f"| {r.name} | {r.flops:.3g} | {r.comm_bytes / 1e6:.2f} "
+                f"| {r.hbm_bytes / 1e6:.2f} | {r.modeled_ms:.3f} "
+                f"| {r.bound} | {r.share:.1%} "
+                f"| {'-' if r.measured_ms is None else f'{r.measured_ms:.3f}'} "
+                f"| {'-' if r.comm_exposed_ms is None else f'{r.comm_exposed_ms:.3f}'} |")
+        foot = [f"modeled_step_ms={self.modeled_step_ms:.3f}"]
+        if self.step_time_ms is not None:
+            foot.append(f"measured_step_ms={self.step_time_ms:.3f}"
+                        f" ({self.measured_source})")
+        if self.comm_exposed_ms is not None:
+            foot.append(f"comm_exposed_ms={self.comm_exposed_ms:.3f}")
+        if self.overlap_efficiency is not None:
+            foot.append(f"overlap_efficiency={self.overlap_efficiency:.3f}")
+        if self.xla_flops:
+            delta = self.flops / self.xla_flops - 1.0
+            foot.append(f"modeled_flops={self.flops:.4g} vs "
+                        f"xla_flops={self.xla_flops:.4g} ({delta:+.1%})")
+        rows.append("")
+        rows.append("; ".join(foot))
+        for n in self.notes:
+            rows.append(f"note: {n}")
+        return "\n".join(rows)
+
+    def json_lines(self) -> str:
+        """One JSON object per region plus a ``{"region": "_step"}``
+        summary line — the JSONL twin of :meth:`markdown`."""
+        lines = [json.dumps({"region": r.name, **r.as_dict()})
+                 for r in self.regions]
+        lines.append(json.dumps({
+            "region": "_step", "modeled_step_ms": self.modeled_step_ms,
+            "step_time_ms": self.step_time_ms,
+            "comm_exposed_ms": self.comm_exposed_ms,
+            "overlap_efficiency": self.overlap_efficiency,
+            "flops": self.flops, "xla_flops": self.xla_flops,
+            "measured_source": self.measured_source,
+            "device": self.spec.name, "notes": self.notes}))
+        return "\n".join(lines)
+
+
+def region_times_from_spans(spans, regions: Sequence[str] = DEFAULT_REGIONS
+                            ) -> Dict[str, float]:
+    """Per-region wall milliseconds from drained
+    :class:`~apex_tpu.observability.trace.Span` tuples: a span accrues to
+    the innermost known region named in its span name — the same
+    innermost-match rule the cost model buckets by, so measured walls and
+    modeled costs land in the same region (a ``.../gpt_attention/
+    flash_attention`` span accrues to ``flash_attention``, not the outer
+    phase). Host-side timers wrap device work conservatively — treat
+    these as upper bounds."""
+    out: Dict[str, float] = {}
+    for span in spans:
+        region = _region_of(span.name, regions)
+        if region != UNATTRIBUTED:
+            out[region] = out.get(region, 0.0) \
+                + (span.end - span.start) * 1e3
+    return out
+
+
+def region_times_from_trace_dir(trace_dir: str,
+                                regions: Sequence[str] = DEFAULT_REGIONS,
+                                steps: int = 1) -> Dict[str, float]:
+    """Per-region wall milliseconds from a ``jax.profiler.trace`` log
+    directory: sums the durations of Chrome-trace complete events (the
+    ``*.trace.json.gz`` the profiler emits) whose name or args mention a
+    known region. ``named_scope`` names reach the device events through
+    HLO op metadata, so this attributes real kernel time — but fused ops
+    carry only one representative name, so treat the split as
+    approximate. Events accrue to the *innermost* known region on their
+    scope path — the same innermost-match rule the cost model buckets
+    by, so nested regions (``flash_attention`` inside ``gpt_attention``)
+    carve out their own measured time exactly as they carve out their
+    modeled time.
+
+    Normalization — the roofline model is per-chip and per-step, so the
+    walls must be too: durations sum *within* each Chrome-trace process
+    track (``pid`` — one per device core or derived xprof plane) and
+    average *across* tracks, so a multi-chip capture (or xprof's
+    duplicate scope planes) reads as one chip's wall, not an
+    n_devices-fold sum that would saturate every exposure cap. ``steps``
+    is the number of profiled steps the capture spans
+    (``profile_trace``-style captures record several): the per-track
+    sums divide by it so the result is PER-STEP milliseconds. Returns {}
+    when no trace files are found."""
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    acc: Dict[str, Dict[Any, float]] = {}
+    pattern = os.path.join(trace_dir, "**", "*.trace.json.gz")
+    for path in sorted(glob.glob(pattern, recursive=True)):
+        try:
+            with gzip.open(path, "rt") as f:
+                events = json.load(f).get("traceEvents", [])
+        except (OSError, ValueError):
+            continue
+        for ev in events:
+            if ev.get("ph") != "X":
+                continue
+            hay = ev.get("name", "")
+            args = ev.get("args")
+            if isinstance(args, dict):
+                hay += "/" + "/".join(str(v) for v in args.values())
+            region = _region_of(hay, regions)
+            if region != UNATTRIBUTED:
+                track = (path, ev.get("pid", 0))
+                per_track = acc.setdefault(region, {})
+                per_track[track] = per_track.get(track, 0.0) \
+                    + float(ev.get("dur", 0.0)) / 1e3
+    return {name: sum(tracks.values()) / len(tracks) / steps
+            for name, tracks in acc.items()}
+
+
+def attribute(program, step_time_s: Optional[float] = None, *,
+              args: Optional[tuple] = None,
+              compiled=None,
+              spec: Optional[DeviceSpec] = None,
+              regions: Sequence[str] = DEFAULT_REGIONS,
+              region_times: Optional[Dict[str, float]] = None,
+              trace_dir: Optional[str] = None,
+              spans=None, trace_steps: int = 1) -> AttributionReport:
+    """Model ``program`` (see :func:`~apex_tpu.pyprof.model.jaxpr_of` for
+    accepted forms) and join it against a measured ``step_time_s``.
+
+    ``compiled`` (the AOT executable, e.g. ``traced.lower().compile()``)
+    adds the XLA ``flops_budget`` cross-check to the report.
+    ``region_times``, ``spans``, and ``trace_dir`` supply per-region wall
+    milliseconds, consulted in that order — the first source that yields
+    any region wins, and a source that matches nothing (an empty span
+    drain, a trace with no known-region events) falls through to the
+    next rather than silently discarding it. Without any, the measured
+    step is apportioned by modeled share. ``trace_steps`` is the number
+    of steps a ``trace_dir`` capture spans (durations divide by it so
+    the walls are per-step; see :func:`region_times_from_trace_dir`).
+    """
+    cost: ProgramCost = model_program(program, args, spec=spec,
+                                      regions=regions)
+    spec = cost.spec
+    modeled_total = cost.modeled_ms
+    step_ms = None if step_time_s is None else step_time_s * 1e3
+
+    if not region_times and spans is not None:
+        region_times = region_times_from_spans(spans, regions)
+    if not region_times and trace_dir is not None:
+        region_times = region_times_from_trace_dir(trace_dir, regions,
+                                                   steps=trace_steps)
+    if region_times:
+        measured_source = "trace"
+    elif step_ms is not None:
+        measured_source = "scaled"
+    else:
+        measured_source = "none"
+
+    regions_out: List[RegionAttribution] = []
+    exposed_total = 0.0
+    comm_total_ms = 0.0
+    have_exposure = False
+    unmeasured_comm: List[str] = []
+    for rc in cost.regions.values():
+        share = rc.modeled_ms / modeled_total if modeled_total > 0 else 0.0
+        measured = None
+        if region_times and rc.name in region_times:
+            measured = region_times[rc.name]
+        elif measured_source == "scaled" and step_ms is not None:
+            measured = step_ms * share
+        exposed = None
+        if measured is not None:
+            # time beyond the on-chip roofline, attributable to unhidden
+            # communication — capped at the modeled comm time so a
+            # comm-free region can never report exposure
+            exposed = min(rc.comm_ms,
+                          max(0.0, measured - max(rc.compute_ms,
+                                                  rc.hbm_ms)))
+            exposed_total += exposed
+            have_exposure = True
+            # only regions with a measured wall enter the
+            # overlap_efficiency denominator: a partial trace (fusion
+            # renamed a region's events away) must not let unobserved
+            # comm inflate the ratio toward "everything hidden"
+            comm_total_ms += rc.comm_ms
+        elif rc.comm_ms > 0.0:
+            unmeasured_comm.append(rc.name)
+        regions_out.append(RegionAttribution(
+            name=rc.name, flops=rc.flops, comm_bytes=rc.comm_bytes,
+            hbm_bytes=rc.hbm_bytes, compute_ms=rc.compute_ms,
+            hbm_ms=rc.hbm_ms, comm_ms=rc.comm_ms,
+            modeled_ms=rc.modeled_ms, bound=rc.bound, share=share,
+            measured_ms=measured, comm_exposed_ms=exposed))
+
+    xla = flops_budget(compiled) if compiled is not None else None
+    overlap = None
+    if have_exposure and comm_total_ms > 0.0:
+        overlap = min(1.0, max(0.0, 1.0 - exposed_total / comm_total_ms))
+    notes = list(cost.notes)
+    if have_exposure and unmeasured_comm:
+        notes.append(
+            "no measured wall for comm-bearing region(s) "
+            f"{sorted(unmeasured_comm)} — their modeled comm is excluded "
+            "from overlap_efficiency (a partial trace cannot claim their "
+            "bytes were hidden)")
+    return AttributionReport(
+        regions=regions_out, spec=spec, modeled_step_ms=modeled_total,
+        step_time_ms=step_ms,
+        comm_exposed_ms=exposed_total if have_exposure else None,
+        overlap_efficiency=overlap, flops=cost.flops, xla_flops=xla,
+        measured_source=measured_source, notes=notes)

@@ -264,6 +264,44 @@ class GPTHybridTrainer:
         checked.lower = jitted.lower  # raw AOT surface (no stamp check)
         return checked
 
+    def attribution_report(self, stage_stack, shared, opt_state, ls,
+                           tokens, targets, *, step_time_s=None, iters=3,
+                           spec=None, regions=None, trace_dir=None,
+                           spans=None, trace_steps=1):
+        """Per-region step-time attribution of THIS trainer's jitted step
+        (:mod:`apex_tpu.pyprof`): traces the step over the given state,
+        prices every ``named_scope`` region against the chip roofline
+        (FLOPs / HBM bytes / ICI bytes — the ``pipe x data x tensor``
+        collectives priced ring-hop-aware), measures the wall step time
+        when ``step_time_s`` is not supplied (``iters`` timed executions
+        of the freshly compiled step, donation off so the caller's state
+        stays valid), and returns the
+        :class:`~apex_tpu.pyprof.attribute.AttributionReport` — markdown
+        via ``.markdown()``, JSONL via ``.json_lines()``, and the
+        ``perf/*`` gauges via ``StepReporter.attach_attribution``.
+        ``trace_dir``/``spans`` upgrade the exposure accounting from
+        modeled-share scaling to measured per-region walls
+        (``trace_steps`` = steps the capture spans, so trace walls read
+        per-step)."""
+        args = (stage_stack, shared, opt_state, ls, tokens, targets)
+        traced = jax.jit(self.train_step).trace(*args)
+        compiled = traced.lower().compile()
+        if step_time_s is None:
+            import time as _time
+            from apex_tpu.utils.timers import device_fence
+            out = compiled(*args)
+            device_fence(out)
+            t0 = _time.perf_counter()
+            for _ in range(max(1, iters)):
+                out = compiled(*args)
+            device_fence(out)
+            step_time_s = (_time.perf_counter() - t0) / max(1, iters)
+        from apex_tpu.pyprof import attribute
+        kwargs = {} if regions is None else {"regions": regions}
+        return attribute(traced, step_time_s, compiled=compiled,
+                         spec=spec, trace_dir=trace_dir, spans=spans,
+                         trace_steps=trace_steps, **kwargs)
+
     def train_step_with_metrics(self, stage_stack, shared, opt_state, ls,
                                 tokens, targets):
         """:meth:`train_step` plus the step's telemetry: returns

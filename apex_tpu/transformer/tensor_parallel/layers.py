@@ -32,6 +32,7 @@ reference tests rely on, ``tests/L0/run_transformer/test_layers.py``).
 
 from __future__ import annotations
 
+import contextlib
 import math
 from typing import Any, Callable, Optional, Tuple
 
@@ -164,6 +165,18 @@ class ColumnParallelLinear:
 
     def __call__(self, params: dict, x: jnp.ndarray
                  ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+        # pyprof attribution region: the GEMM *and* its dependent TP
+        # collectives in one bucket — the unit the overlap-exposure
+        # accounting prices (scripts/check_annotations.py contract).
+        # tp=1 stays scope-free so single-chip programs attribute to
+        # the enclosing model phase (gpt_attention/gpt_mlp) instead.
+        scope = (jax.named_scope("tp_column_linear")
+                 if self.world_size > 1 else contextlib.nullcontext())
+        with scope:
+            return self._forward(params, x)
+
+    def _forward(self, params: dict, x: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
         w = _local_shard(params["weight"], self.world_size)
         if (self.world_size > 1 and self.sequence_parallel
                 and self.tp_comm_overlap):
@@ -250,6 +263,14 @@ class RowParallelLinear:
         return p
 
     def __call__(self, params: dict, x: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+        # pyprof attribution region, tp>1 only — see ColumnParallelLinear
+        scope = (jax.named_scope("tp_row_linear")
+                 if self.world_size > 1 else contextlib.nullcontext())
+        with scope:
+            return self._forward(params, x)
+
+    def _forward(self, params: dict, x: jnp.ndarray
                  ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
         w = _local_shard(params["weight"], self.world_size)
         if not self.input_is_parallel and self.world_size > 1:

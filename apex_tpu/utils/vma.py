@@ -112,9 +112,13 @@ def fixed_point_vma(body: Callable, init: Any, x0: Any = None,
     return vma_tree
 
 
-def scan_stable_vma(body: Callable, init: Any, xs: Any, max_iters: int = 4):
+def scan_stable_vma(body: Callable, init: Any, xs: Any, max_iters: int = 4,
+                    unroll: Any = 1):
     """``lax.scan`` whose carry VMA is fixed-pointed against the body
-    (per-leaf, via :func:`fixed_point_vma`)."""
+    (per-leaf, via :func:`fixed_point_vma`). ``unroll`` passes through to
+    ``lax.scan`` (int factor or ``True`` for full unrolling — the form
+    whose compiled program XLA's cost analysis can count end to end,
+    used by the pyprof attribution validation path)."""
     first_x = jax.tree_util.tree_map(
         lambda v: jax.lax.index_in_dim(v, 0, 0, keepdims=False), xs)
     vma_tree = fixed_point_vma(body, init, first_x, max_iters=max_iters)
@@ -125,7 +129,7 @@ def scan_stable_vma(body: Callable, init: Any, xs: Any, max_iters: int = 4):
 
     return jax.lax.scan(
         stable_body, jax.tree_util.tree_map(cast_to_vma, init, vma_tree),
-        xs)
+        xs, unroll=unroll)
 
 
 def varying_all_gather(x: jnp.ndarray, axis_name: str, axis: int = 0,

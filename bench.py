@@ -19,7 +19,14 @@ DeepLearningExamples ResNet-50 AMP number (~2470 imgs/sec per A100), the
 "8xA100 amp-O2+DDP" north-star divided per chip; the reference repo itself
 publishes no numbers (BASELINE.md). The line also carries ``mfu``
 (model-flops-utilization from XLA's compiled cost analysis over the chip's
-peak bf16 throughput), ``std_ms``, and ``step_ms``.
+peak bf16 throughput), ``std_ms``, and ``step_ms``. Every headline/GPT
+line additionally carries ``modeled_step_ms`` (the pyprof per-region
+roofline lower bound of the exact program measured — the denominator
+"how fast could this step possibly run") and ``comm_exposed_ms``
+(modeled collective traffic the measured step failed to hide under
+compute; 0.0 on single-chip programs) — see docs/OBSERVABILITY.md
+"Step-time attribution" and ``scripts/attribute_step.py`` for the full
+per-region breakdown.
 
 Other configs:
   config 2 — FusedLayerNorm fwd+bwd, the library's auto-selected path
@@ -92,6 +99,32 @@ def _mem_extra(compiled) -> dict:
         return {}
     return {"temp_bytes": int(budget["temp_bytes"]),
             "peak_hbm_bytes": int(budget["peak_hbm_bytes"])}
+
+
+def _attrib_extra(traced, step_ms) -> dict:
+    """``modeled_step_ms``/``comm_exposed_ms`` extras for a bench line:
+    the pyprof roofline lower bound of the traced step and the modeled
+    communication the measured step failed to hide (0.0 on comm-free
+    single-chip programs; see docs/OBSERVABILITY.md "Step-time
+    attribution"). {} when the model cannot price the program, so lines
+    never carry fabricated numbers."""
+    try:
+        from apex_tpu.pyprof import attribute
+        rep = attribute(traced, step_ms / 1e3)
+        out = {"modeled_step_ms": round(rep.modeled_step_ms, 3)}
+        if rep.comm_exposed_ms is not None:
+            out["comm_exposed_ms"] = round(rep.comm_exposed_ms, 3)
+        return out
+    except Exception:
+        return {}
+
+
+def _trace_and_compile(jitted, *args):
+    """AOT ``(traced, compiled)`` of a jitted step: the traced stage keeps
+    the jaxpr the pyprof attribution walks, ``.lower().compile()`` is the
+    identical executable the timing loop runs."""
+    traced = jitted.trace(*args)
+    return traced, traced.lower().compile()
 
 
 def _sync(out) -> None:
@@ -188,7 +221,8 @@ def bench_headline(iters=50, warmup=5):
     # optimizer); falls back to the analytic RN50 figure (2*4.1 GMACs fwd,
     # x3 for train) if the backend has no cost analysis. The compiled
     # executable is reused for the timing loop so the program compiles once.
-    compiled = step.lower(params, bn_state, opt_state, ls).compile()
+    traced, compiled = _trace_and_compile(step, params, bn_state,
+                                          opt_state, ls)
     flops_per_step = flops_budget(compiled)
     if flops_per_step is None:
         flops_per_step = 3 * 2 * 4.1e9 * batch
@@ -202,7 +236,8 @@ def bench_headline(iters=50, warmup=5):
           imgs_per_sec / A100_AMP_RN50_IMGS_PER_SEC,
           step_ms=round(step_ms, 3),
           std_ms=round(float(np.std(times) * 1e3), 3),
-          mfu=round(mfu, 4), iters=iters)
+          mfu=round(mfu, 4), iters=iters,
+          **_attrib_extra(traced, step_ms))
 
 
 def _device_loop_ms(step_fn, init_carry, k=50, reps=5):
@@ -329,22 +364,31 @@ def bench_optimizer():
 
 
 def _gpt_train_step(batch=8, seq=1024, hidden=768, layers=12, heads=12,
-                    vocab=32768, remat_policy=None):
+                    vocab=32768, remat_policy=None, **cfg_overrides):
     """The canonical config-5 GPT-small train step (flash attention,
     FusedAdam, dynamic loss scaling, donated buffers), AOT-compiled.
-    Shared by :func:`bench_gpt` (the baseline row) and every
-    :func:`bench_gpt_remat` leg, so the remat A/B measures exactly the
-    baseline program modulo policy. Returns ``(cfg, args, wrapped,
-    compiled)``: ``wrapped(*args)`` runs one step and threads the donated
-    buffers back as the next call's args (the `_timeit` convention)."""
+    Shared by :func:`bench_gpt` (the baseline row), every
+    :func:`bench_gpt_remat` leg, and ``scripts/attribute_step.py`` (which
+    passes ``compute_dtype``/``use_flash``/``layer_scan_unroll`` through
+    ``cfg_overrides`` to build its XLA-countable validation twin of the
+    SAME program), so neither the remat sweep nor the attribution
+    instrument can drift from the baseline step. ``cfg_overrides`` are
+    extra :class:`GPTConfig` fields laid over the bench defaults.
+    Returns ``(cfg, args, wrapped, compiled, traced)``: ``wrapped(*args)``
+    runs one step and threads the donated buffers back as the next
+    call's args (the `_timeit` convention); ``traced`` is the
+    pre-lowering stage the pyprof attribution
+    (``modeled_step_ms``/``comm_exposed_ms`` columns) walks."""
     from apex_tpu.amp.scaler import DynamicLossScale, all_finite
     from apex_tpu.models import GPTConfig, GPTModel
     from apex_tpu.optimizers import FusedAdam
 
-    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
-                    num_layers=layers, num_attention_heads=heads,
-                    max_position_embeddings=seq,
-                    compute_dtype=jnp.bfloat16, remat_policy=remat_policy)
+    cfg_kw = dict(vocab_size=vocab, hidden_size=hidden,
+                  num_layers=layers, num_attention_heads=heads,
+                  max_position_embeddings=seq,
+                  compute_dtype=jnp.bfloat16, remat_policy=remat_policy)
+    cfg_kw.update(cfg_overrides)
+    cfg = GPTConfig(**cfg_kw)
     model = GPTModel(cfg)
     params = model.init(jax.random.PRNGKey(0))
     opt = FusedAdam(lr=1e-4)
@@ -366,13 +410,14 @@ def _gpt_train_step(batch=8, seq=1024, hidden=768, layers=12, heads=12,
                                      grads_finite=finite)
         return params, opt_state, new_ls
 
-    compiled = step.lower(params, opt_state, ls, tokens).compile()
+    traced, compiled = _trace_and_compile(step, params, opt_state, ls,
+                                          tokens)
 
     def wrapped(params, opt_state, ls, tokens):
         params, opt_state, ls = compiled(params, opt_state, ls, tokens)
         return params, opt_state, ls, tokens
 
-    return cfg, (params, opt_state, ls, tokens), wrapped, compiled
+    return cfg, (params, opt_state, ls, tokens), wrapped, compiled, traced
 
 
 def bench_gpt(iters=20, warmup=3):
@@ -380,7 +425,8 @@ def bench_gpt(iters=20, warmup=3):
     Mosaic-compiled flash-attention kernels end to end (fwd+bwd), FusedAdam,
     dynamic loss scaling."""
     batch, seq = 8, 1024
-    cfg, args, wrapped, compiled = _gpt_train_step(batch=batch, seq=seq)
+    cfg, args, wrapped, compiled, traced = _gpt_train_step(batch=batch,
+                                                           seq=seq)
     params = args[0]
     times = _timeit(wrapped, args, iters, warmup)
     tok_per_sec = batch * seq / float(np.mean(times))
@@ -400,12 +446,14 @@ def bench_gpt(iters=20, warmup=3):
                      + 12.0 * cfg.num_layers * cfg.hidden_size * seq)
     vs_anchor = tok_per_sec / (0.40 * _peak_flops() / flops_per_tok)
     mfu = tok_per_sec * flops_per_tok / _peak_flops()
+    step_ms = float(np.mean(times) * 1e3)
     _emit("gpt_small_train_tokens_per_sec", tok_per_sec, "tokens/sec",
           vs_anchor, anchor="40pct_mfu_this_chip",
           mfu=round(float(mfu), 4),
-          step_ms=round(float(np.mean(times) * 1e3), 3),
+          step_ms=round(step_ms, 3),
           std_ms=round(float(np.std(times) * 1e3), 3),
-          batch=batch, seq=seq, **_mem_extra(compiled))
+          batch=batch, seq=seq, **_mem_extra(compiled),
+          **_attrib_extra(traced, step_ms))
 
 
 def bench_gpt_remat(iters=10, warmup=2, batch=8, seq=1024, hidden=768,
@@ -433,12 +481,14 @@ def bench_gpt_remat(iters=10, warmup=2, batch=8, seq=1024, hidden=768,
     second memory space (TPU); read its step_ms there
     (docs/PERF.md "Remat & HBM")."""
     def measure(policy):
-        _cfg, args, wrapped, compiled = _gpt_train_step(
+        _cfg, args, wrapped, compiled, traced = _gpt_train_step(
             batch=batch, seq=seq, hidden=hidden, layers=layers,
             heads=heads, vocab=vocab, remat_policy=policy)
         mem = _mem_extra(compiled)
         times = _timeit(wrapped, args, iters, warmup)
-        return float(np.mean(times) * 1e3), float(np.std(times) * 1e3), mem
+        ms = float(np.mean(times) * 1e3)
+        mem.update(_attrib_extra(traced, ms))
+        return ms, float(np.std(times) * 1e3), mem
 
     results = {}
     for policy in ("none", "selective", "full", "offload"):
@@ -532,7 +582,7 @@ def bench_gpt_sp_overlap(iters=10, warmup=2, batch=8, seq=1024,
             # consumed by the first call. AOT-compiled so the memory plan
             # (temp_bytes) is recorded alongside the timing.
             p0 = jax.tree_util.tree_map(jnp.copy, params)
-            compiled = step.lower(p0, tokens).compile()
+            traced, compiled = _trace_and_compile(step, p0, tokens)
 
             def wrapped(params, loss, tokens):
                 return compiled(params, tokens)
@@ -540,15 +590,20 @@ def bench_gpt_sp_overlap(iters=10, warmup=2, batch=8, seq=1024,
             times = _timeit(wrapped, (p0, jnp.float32(0.0), tokens),
                             iters, warmup)
             return (batch * seq / float(np.mean(times)), times,
-                    _mem_extra(compiled))
+                    _mem_extra(compiled), traced)
 
-        fused_tps, _, _ = measure(False)
-        overlap_tps, times, mem = measure(True)
+        fused_tps, _, _, _ = measure(False)
+        overlap_tps, times, mem, traced = measure(True)
+        step_ms = float(np.mean(times) * 1e3)
+        # the attribution here prices the ring ppermute chains hop by hop
+        # — comm_exposed_ms is the number the overlap machinery exists to
+        # drive to zero (CPU hosts have no ICI; read it on a TPU run)
         _emit("gpt_sp_overlap_tokens_per_sec", overlap_tps, "tokens/sec",
               overlap_tps / fused_tps,
               fused_tps=round(fused_tps, 2), tp=2, batch=batch, seq=seq,
-              step_ms=round(float(np.mean(times) * 1e3), 3),
-              std_ms=round(float(np.std(times) * 1e3), 3), **mem)
+              step_ms=round(step_ms, 3),
+              std_ms=round(float(np.std(times) * 1e3), 3), **mem,
+              **_attrib_extra(traced, step_ms))
     finally:
         parallel_state.destroy_model_parallel()
 

@@ -27,13 +27,33 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # annotation -> source files allowed to carry it (repo-relative). The
 # contract is "exists in at least one of its owning files": moving an
 # annotation to an unrelated module is a docs-breaking change and should
-# fail here until the table (and docs) are updated.
+# fail here until the table (and docs) are updated. The table doubles as
+# the pyprof attribution-region vocabulary: apex_tpu/pyprof/model.py's
+# DEFAULT_REGIONS must stay a subset of these keys (asserted in
+# tests/test_pyprof.py), so every region a step-time attribution report
+# names is guaranteed to exist as a named_scope in source.
 ANNOTATIONS = {
     "apex_ddp_allreduce": ["apex_tpu/parallel/distributed.py"],
+    "apex_ddp_bucketed_allreduce": ["apex_tpu/parallel/distributed.py"],
     "sync_bn_stats": ["apex_tpu/parallel/sync_batchnorm.py"],
     "pipeline_tick": [
         "apex_tpu/transformer/pipeline_parallel/schedules.py"],
     "flash_attention": ["apex_tpu/ops/flash_attention.py"],
+    "optimizer_step": ["apex_tpu/optimizers/_base.py"],
+    # model phases (pyprof attribution regions)
+    "gpt_embed": ["apex_tpu/models/gpt.py"],
+    "gpt_ln": ["apex_tpu/models/gpt.py"],
+    "gpt_attention": ["apex_tpu/models/gpt.py"],
+    "gpt_mlp": ["apex_tpu/models/gpt.py"],
+    "gpt_head_loss": ["apex_tpu/models/gpt.py"],
+    "rn50_stem": ["apex_tpu/models/resnet.py"],
+    "rn50_body": ["apex_tpu/models/resnet.py"],
+    "rn50_head": ["apex_tpu/models/resnet.py"],
+    # tensor-parallel layers (GEMM + dependent collective, tp > 1 only)
+    "tp_column_linear": [
+        "apex_tpu/transformer/tensor_parallel/layers.py"],
+    "tp_row_linear": [
+        "apex_tpu/transformer/tensor_parallel/layers.py"],
 }
 
 

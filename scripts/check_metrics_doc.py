@@ -2,8 +2,8 @@
 """Static check: every in-graph metric recorded in source is documented.
 
 The per-step metric families (``health/*``, ``tp/*``, ``amp/*``,
-``ddp/*``, ``pipeline/*``, ``optim/*``, ``zero/*``, ``mem/*``) are a
-public contract — dashboards
+``ddp/*``, ``pipeline/*``, ``optim/*``, ``zero/*``, ``mem/*``,
+``perf/*``) are a public contract — dashboards
 and the crash-dump post-mortem workflow key on the names — and the
 contract lives in the docs/OBSERVABILITY.md table. A ``record()`` call
 added without a doc row silently grows an undocumented surface; this
@@ -38,7 +38,7 @@ DOC = os.path.join("docs", "OBSERVABILITY.md")
 # metric families under the documentation contract; names outside these
 # prefixes (host registry internals, ad-hoc example metrics) are exempt
 PREFIXES = ("health/", "tp/", "amp/", "ddp/", "pipeline/", "optim/",
-            "zero/", "mem/")
+            "zero/", "mem/", "perf/")
 
 # callees whose literal first argument is a metric name: in-graph
 # ``ingraph.record(...)`` and host-registry ``registry.gauge(...)`` (the

@@ -62,8 +62,7 @@ def test_fp16_util_helpers():
 def test_stub_packages_raise_with_migration_pointers():
     import apex_tpu
 
-    for mod_name, needle in [("reparameterization", "WeightNorm"),
-                             ("pyprof", "profile_trace")]:
+    for mod_name, needle in [("reparameterization", "WeightNorm")]:
         mod = getattr(apex_tpu, mod_name)
         with pytest.raises(NotImplementedError) as e:
             mod.anything
@@ -71,6 +70,37 @@ def test_stub_packages_raise_with_migration_pointers():
 
     from apex_tpu.parallel import multiproc
     assert multiproc.main() == 1
+
+
+def test_pyprof_nvtx_era_names_keep_the_stub_contract():
+    """pyprof graduated to a real package in round 6, but the NVTX-era
+    surface the old stub documented (`nvtx`, `prof`, `parse`) must keep
+    raising NotImplementedError with a pointer into the new
+    annotate -> trace -> attribute API."""
+    from apex_tpu import pyprof
+
+    for name, needle in [("nvtx", "annotate"),
+                         ("prof", "attribute"),
+                         ("parse", "region_times_from_trace_dir")]:
+        with pytest.raises(NotImplementedError) as e:
+            getattr(pyprof, name)
+        msg = str(e.value)
+        assert needle in msg and "annotate" in msg, msg
+    # anything else is a plain missing attribute, not a stub raise
+    with pytest.raises(AttributeError):
+        pyprof.definitely_not_an_api
+
+
+def test_pyprof_new_surface_is_real():
+    from apex_tpu import pyprof
+
+    # the annotate stage IS jax.named_scope
+    assert pyprof.annotate is jax.named_scope
+    for name in ("attribute", "model_program", "jaxpr_of",
+                 "region_times_from_spans", "region_times_from_trace_dir"):
+        assert callable(getattr(pyprof, name)), name
+    assert pyprof.DEFAULT_REGIONS and "gpt_attention" in \
+        pyprof.DEFAULT_REGIONS
 
 
 def test_rnn_package_is_real():
