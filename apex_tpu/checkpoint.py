@@ -43,13 +43,14 @@ import json
 import os
 import re
 import shutil
+import warnings
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "latest_step",
-           "all_steps"]
+           "all_steps", "torn_steps"]
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
 _HOST_FILE = "host.json"
@@ -126,6 +127,22 @@ def all_steps(directory: str) -> list:
     return sorted(steps)
 
 
+def torn_steps(directory: str) -> list:
+    """Step numbers of TORN checkpoint dirs — present on disk but missing
+    their COMMITTED marker (a writer died mid-save, or another process is
+    still writing them), ascending. Invisible to :func:`all_steps` /
+    :func:`latest_step`; :func:`restore_checkpoint` warns and skips them."""
+    if not os.path.isdir(directory):
+        return []
+    steps = []
+    for name in os.listdir(directory):
+        m = _STEP_RE.match(name)
+        if m and not os.path.exists(
+                os.path.join(directory, name, _COMMIT_FILE)):
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
 def latest_step(directory: str) -> Optional[int]:
     steps = all_steps(directory)
     return steps[-1] if steps else None
@@ -134,11 +151,15 @@ def latest_step(directory: str) -> Optional[int]:
 def save_checkpoint(directory: str, state: Any, step: int, *,
                     fp32_on_disk: bool = True,
                     host_state: Optional[Dict[str, Any]] = None,
-                    keep: Optional[int] = None) -> str:
+                    keep: Optional[int] = None,
+                    keep_last: Optional[int] = None) -> str:
     """Write ``state`` (any pytree of jax/numpy arrays) at ``step``.
 
     Returns the checkpoint path. ``host_state`` must be JSON-serializable.
-    ``keep=N`` (N >= 1) prunes all but the newest N committed checkpoints.
+    ``keep_last=N`` (N >= 1) prunes all but the newest N COMMITTED
+    checkpoints after the new one commits; a torn/uncommitted dir — one
+    another (possibly still-running) writer may own — is NEVER deleted by
+    GC. ``keep=`` is the legacy spelling of the same parameter.
 
     Multi-host: the orbax array save is collective (every process calls
     ``save_checkpoint`` and writes the shards it owns); the directory
@@ -152,8 +173,14 @@ def save_checkpoint(directory: str, state: Any, step: int, *,
     """
     import orbax.checkpoint as ocp
 
-    if keep is not None and keep < 1:
-        raise ValueError("keep must be >= 1")
+    if keep is not None and keep_last is not None and keep != keep_last:
+        raise ValueError(
+            f"keep={keep} and keep_last={keep_last} are the same parameter "
+            "spelled twice; pass only keep_last")
+    if keep_last is None:
+        keep_last = keep
+    if keep_last is not None and keep_last < 1:
+        raise ValueError("keep_last must be >= 1")
     lead = jax.process_index() == 0
     path = _step_dir(directory, step)
     if lead:
@@ -178,9 +205,11 @@ def save_checkpoint(directory: str, state: Any, step: int, *,
         with open(os.path.join(path, _COMMIT_FILE), "w") as f:
             f.write("ok\n")
 
-        if keep is not None:
+        if keep_last is not None:
+            # all_steps lists only COMMITTED dirs, so a torn dir another
+            # writer may still own is structurally exempt from GC
             steps = all_steps(directory)
-            for old in steps[:max(len(steps) - keep, 0)]:
+            for old in steps[:max(len(steps) - keep_last, 0)]:
                 shutil.rmtree(_step_dir(directory, old), ignore_errors=True)
     return path
 
@@ -194,14 +223,29 @@ def restore_checkpoint(directory: str, target: Any,
     ``target`` is a pytree of arrays or ``ShapeDtypeStruct``s (with optional
     shardings); restored leaves land sharded accordingly. Returns
     ``(state, host_state)``.
+
+    Torn dirs (a ``step_*`` dir without its COMMITTED marker — a writer
+    died mid-save) are SKIPPED, not an error: the latest-step resolution
+    falls back to the newest COMMITTED step and a ``UserWarning`` names
+    every torn step it skipped over. Only an *explicitly requested*
+    ``step=`` that is torn raises.
     """
     import orbax.checkpoint as ocp
 
     if step is None:
         step = latest_step(directory)
+        torn = torn_steps(directory)
+        skipped = [s for s in torn if step is None or s > step]
+        if skipped:
+            warnings.warn(
+                f"skipping torn (uncommitted) checkpoint dir(s) at step(s) "
+                f"{skipped} under {directory!r}; "
+                + (f"falling back to committed step {step}" if step
+                   is not None else "no committed checkpoint remains"))
         if step is None:
             raise FileNotFoundError(
-                f"no committed checkpoint under {directory!r}")
+                f"no committed checkpoint under {directory!r}"
+                + (f" (only torn dirs at steps {torn})" if torn else ""))
     path = _step_dir(directory, step)
     if not os.path.exists(os.path.join(path, _COMMIT_FILE)):
         raise FileNotFoundError(f"checkpoint at {path!r} is not committed")

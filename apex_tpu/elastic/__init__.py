@@ -1,0 +1,36 @@
+"""Elastic training runtime: survive preemption without losing the run.
+
+The production run-loop layer over :class:`~apex_tpu.training
+.GPTHybridTrainer` (ROADMAP item 4 — "heavy traffic" for training jobs):
+
+- :mod:`~apex_tpu.elastic.ckpt` — CheckFreq-style async checkpointing:
+  snapshot to host inside the step cadence, serialize off-thread with the
+  COMMITTED-marker atomicity of :mod:`apex_tpu.checkpoint`, bounded
+  retry-with-backoff, ``keep_last`` GC, ``ckpt/*`` metrics.
+- :mod:`~apex_tpu.elastic.runner` — the preemption-safe step loop:
+  polls :class:`~apex_tpu.utils.autoresume.AutoResume`, drains the
+  in-flight save, writes a final checkpoint, and requests a clean
+  restart inside the SIGTERM grace window; on startup restores the
+  latest COMMITTED checkpoint and continues bitwise.
+- :mod:`~apex_tpu.elastic.faults` — deterministic, seeded fault
+  injection (SIGTERM-at-step-K, transient save ``OSError``\\ s, torn
+  checkpoint dirs) so recovery is *tested*, not hoped for.
+- :mod:`~apex_tpu.elastic.data` — seeded per-host sharded index
+  iteration with a checkpointable cursor and double-buffered
+  ``device_put`` prefetch.
+
+See ``docs/ROBUSTNESS.md`` for the checkpoint format, the preemption
+walkthrough, and the bitwise-resume contract.
+"""
+
+from apex_tpu.elastic.ckpt import (AsyncCheckpointer, host_snapshot,
+                                   owned_copy, snapshot_nbytes)
+from apex_tpu.elastic.data import (PrefetchingIterator,
+                                   ShardedIndexIterator,
+                                   token_batch_fetcher)
+from apex_tpu.elastic.faults import FaultPlan
+from apex_tpu.elastic.runner import ElasticRunner, FitResult
+
+__all__ = ["AsyncCheckpointer", "ElasticRunner", "FaultPlan", "FitResult",
+           "PrefetchingIterator", "ShardedIndexIterator", "host_snapshot",
+           "owned_copy", "snapshot_nbytes", "token_batch_fetcher"]

@@ -1,0 +1,252 @@
+"""Async (off-critical-path) checkpointing.
+
+CheckFreq-style split of :func:`apex_tpu.checkpoint.save_checkpoint` into
+two phases with different latency budgets:
+
+1. **snapshot** (:func:`host_snapshot`) — runs on the training thread
+   inside the step cadence: one ``jax.device_get`` pulls the state pytree
+   to host memory. This is the only part the step loop waits on; it costs
+   a device→host copy, never a disk write.
+2. **serialize** — runs on a background writer thread: the orbax write,
+   the ``host.json`` sidecar, the COMMITTED marker, and ``keep_last`` GC,
+   exactly the :func:`~apex_tpu.checkpoint.save_checkpoint` protocol
+   (COMMITTED is written LAST, so a process killed mid-serialize leaves a
+   torn dir that :func:`~apex_tpu.checkpoint.restore_checkpoint` skips
+   loudly, never a COMMITTED-but-partial one).
+
+At most one save is in flight: a new :meth:`AsyncCheckpointer.save`
+first drains the previous one (and re-raises its failure, if any — a
+background save error is never silent). Transient filesystem errors
+(``OSError``) during serialization are retried with bounded exponential
+backoff before the save is declared failed.
+
+Snapshot scope: ``jax.device_get`` requires every shard to be addressable
+from this process (single-controller / fully-addressable deployments —
+the CPU mesh, single-host TPU slices). Multi-controller jobs should call
+the synchronous collective :func:`~apex_tpu.checkpoint.save_checkpoint`
+directly.
+
+Metrics (host registry, PR 1): ``ckpt/save_ms`` (histogram, serialize
+wall per save), ``ckpt/bytes`` (counter, snapshot bytes handed to the
+writer), ``ckpt/inflight`` (gauge, 0/1), ``ckpt/saves`` (counter,
+committed saves), ``ckpt/retries`` (counter, transient-error retries).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import checkpoint as _ckpt
+from apex_tpu.observability.registry import MetricsRegistry, get_registry
+
+__all__ = ["AsyncCheckpointer", "host_snapshot", "owned_copy",
+           "snapshot_nbytes"]
+
+
+def host_snapshot(state: Any) -> Any:
+    """Device→host copy of ``state``, ready for off-thread serialization.
+
+    Typed PRNG keys are converted to their raw uint32 key data first
+    (``jax.device_get`` cannot fetch extended-dtype arrays; the raw form
+    is exactly what :func:`~apex_tpu.checkpoint.save_checkpoint` stores,
+    and restore rebuilds typed keys from the *target* tree). Blocks until
+    the state's producing computation is done and the bytes are on host —
+    the snapshot is a consistent cut of the step it follows.
+
+    Every leaf is an OWNED copy, never a view: on the CPU backend
+    ``jax.device_get`` can return zero-copy numpy views of the device
+    buffer, and the donated train step (``jit_train_step`` aliases
+    stage/shared/opt_state) reuses exactly those buffers on its next
+    dispatch — a viewing snapshot would hand the background writer memory
+    that is being overwritten/freed under it (observed as glibc heap
+    corruption). The explicit ``np.array(..., copy=True)`` is the
+    snapshot's whole point: after it returns, the live state is free to
+    be donated.
+    """
+
+    def conv(x):
+        if _ckpt._is_prng_key(x):
+            x = jax.random.key_data(x)
+        return np.array(jax.device_get(x), copy=True)
+
+    return jax.tree_util.tree_map(conv, state, is_leaf=_ckpt._is_prng_key)
+
+
+def owned_copy(state: Any) -> Any:
+    """XLA-owned deep copy of a pytree of jax arrays, shardings preserved.
+
+    ``jnp.copy`` emits a real device ``copy`` op the compiler cannot
+    buffer-forward, so every output leaf is a buffer XLA allocated and
+    owns. Restored checkpoints MUST pass through this before entering a
+    donating step: orbax-restored arrays can alias host memory the XLA
+    runtime does not own, and donating such a buffer corrupts the heap
+    (observed as intermittent glibc malloc/segfault aborts on the CPU
+    backend — ``ElasticRunner._restore`` calls this unconditionally).
+    Typed PRNG keys round-trip through their raw key data.
+    """
+
+    def conv(x):
+        if _ckpt._is_prng_key(x):
+            data = jnp.copy(jax.random.key_data(x))
+            return jax.random.wrap_key_data(
+                data, impl=jax.random.key_impl(x))
+        return jnp.copy(x)
+
+    return jax.tree_util.tree_map(conv, state, is_leaf=_ckpt._is_prng_key)
+
+
+def snapshot_nbytes(snapshot: Any) -> int:
+    """Total bytes of a host snapshot (the serialized payload scale)."""
+    return int(sum(np.asarray(leaf).nbytes
+                   for leaf in jax.tree_util.tree_leaves(snapshot)
+                   if hasattr(leaf, "nbytes") or hasattr(leaf, "dtype")))
+
+
+class AsyncCheckpointer:
+    """Background writer around :func:`~apex_tpu.checkpoint.save_checkpoint`.
+
+    ::
+
+        ckpt = AsyncCheckpointer(dir, keep_last=3)
+        for step in ...:
+            state = step_fn(state)
+            if step % interval == 0:
+                ckpt.save(state, step, host_state={"step": step})
+        ckpt.drain()          # join the in-flight save; re-raise failures
+
+    ``fault_hook(step, attempt)`` is called before every serialization
+    attempt (the :class:`~apex_tpu.elastic.faults.FaultPlan` injection
+    point); an ``OSError`` it raises is treated like a real transient
+    filesystem error and retried. ``after_save(step, path)`` runs on the
+    writer thread after a successful commit (fault plans use it to tear
+    markers; production code normally leaves it unset). ``save_fn``
+    overrides the serializer (tests substitute slow/counting stand-ins).
+    """
+
+    def __init__(self, directory: str, *, fp32_on_disk: bool = True,
+                 keep_last: Optional[int] = None, max_retries: int = 3,
+                 backoff_s: float = 0.05,
+                 registry: Optional[MetricsRegistry] = None,
+                 fault_hook: Optional[Callable[[int, int], None]] = None,
+                 after_save: Optional[Callable[[int, str], None]] = None,
+                 save_fn: Optional[Callable[..., str]] = None):
+        if max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        self.directory = directory
+        self.fp32_on_disk = fp32_on_disk
+        self.keep_last = keep_last
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
+        self.fault_hook = fault_hook
+        self.after_save = after_save
+        self._save_fn = save_fn or _ckpt.save_checkpoint
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self.last_saved_step: Optional[int] = None
+        reg = registry if registry is not None else get_registry()
+        self._m_save_ms = reg.histogram("ckpt/save_ms")
+        self._m_bytes = reg.counter("ckpt/bytes")
+        self._m_inflight = reg.gauge("ckpt/inflight")
+        self._m_saves = reg.counter("ckpt/saves")
+        self._m_retries = reg.counter("ckpt/retries")
+        self._m_inflight.set(0)
+
+    # -- writer side ------------------------------------------------------
+    def _serialize(self, snapshot: Any, step: int,
+                   host_state: Optional[Dict[str, Any]]) -> None:
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_retries + 1):
+            if attempt:
+                # bounded exponential backoff between transient failures
+                time.sleep(self.backoff_s * (2.0 ** (attempt - 1)))
+                self._m_retries.inc()
+            try:
+                if self.fault_hook is not None:
+                    self.fault_hook(step, attempt)
+                t0 = time.perf_counter()
+                path = self._save_fn(
+                    self.directory, snapshot, step,
+                    fp32_on_disk=self.fp32_on_disk,
+                    host_state=host_state, keep_last=self.keep_last)
+                self._m_save_ms.observe((time.perf_counter() - t0) * 1e3)
+                self._m_saves.inc()
+                self.last_saved_step = step
+                if self.after_save is not None:
+                    self.after_save(step, path)
+                return
+            except OSError as e:  # transient class: retry with backoff
+                last = e
+        raise OSError(
+            f"checkpoint save at step {step} failed after "
+            f"{self.max_retries + 1} attempt(s)") from last
+
+    def _run(self, snapshot: Any, step: int,
+             host_state: Optional[Dict[str, Any]]) -> None:
+        try:
+            self._serialize(snapshot, step, host_state)
+        except BaseException as e:  # latched; re-raised on next save/drain
+            self._error = e
+        finally:
+            self._m_inflight.set(0)
+
+    # -- trainer side -----------------------------------------------------
+    @property
+    def in_flight(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def save(self, state: Any, step: int, *,
+             host_state: Optional[Dict[str, Any]] = None,
+             block: bool = False) -> None:
+        """Snapshot ``state`` now; serialize it in the background.
+
+        Drains (and error-checks) the previous save first, so at most one
+        write is in flight and a failure surfaces within one save
+        interval. ``block=True`` additionally waits for THIS save (the
+        final/preemption save path).
+        """
+        self.drain()
+        snapshot = host_snapshot(state)
+        self._m_bytes.inc(snapshot_nbytes(snapshot))
+        self._m_inflight.set(1)
+        self._thread = threading.Thread(
+            target=self._run, args=(snapshot, step, host_state),
+            name=f"ckpt-writer-step{step}", daemon=True)
+        self._thread.start()
+        if block:
+            self.drain()
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Join the in-flight save (no-op when idle) and re-raise any
+        latched writer failure. Call before exiting — a preemption must
+        drain, not abandon, the write in progress."""
+        th = self._thread
+        if th is not None:
+            th.join(timeout)
+            if th.is_alive():
+                raise TimeoutError(
+                    f"in-flight checkpoint save did not finish within "
+                    f"{timeout}s")
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    close = drain
+
+    def __enter__(self) -> "AsyncCheckpointer":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.drain()
+        else:  # already unwinding: don't mask the primary exception
+            try:
+                self.drain()
+            except Exception:
+                pass

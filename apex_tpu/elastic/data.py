@@ -1,0 +1,199 @@
+"""Deterministic, resumable, prefetching input pipeline for the dp axis.
+
+Two layers, both pure functions of ``(seed, batch_number)`` so a restart
+can reproduce any point of the stream from one integer cursor:
+
+- :class:`ShardedIndexIterator` — a seeded per-host sampler over row
+  indices: epoch ``e``'s order is a ``numpy.random.RandomState``
+  permutation keyed on ``(seed, e)`` (no wall-clock entropy), each global
+  batch is a contiguous slice of it, and each host takes its own
+  contiguous sub-slice (``global_batch / num_hosts`` rows feeding this
+  host's dp ranks). The cursor is a single integer — batches consumed —
+  and :meth:`~ShardedIndexIterator.batch_indices` is random-access, so
+  seek == assignment.
+- :class:`PrefetchingIterator` — wraps a sampler + a host ``fetch``
+  function with a ``depth``-deep ``jax.device_put`` pipeline: while the
+  step consumes batch ``k``, batches ``k+1..k+depth`` are already
+  dispatched host→HBM (``device_put`` is async), so the copy rides under
+  the step instead of serializing with it. Its ``state_dict`` reports the
+  *consumed* cursor, not the fetched one — prefetched-but-unconsumed
+  batches are refetched after a restore, which is exact because batch
+  ``k`` is a pure function.
+
+The cursor rides in the checkpoint's host sidecar (see
+:class:`~apex_tpu.elastic.runner.ElasticRunner`), making N steps +
+preempt + restore + M steps consume byte-identical data to N+M straight
+steps.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["ShardedIndexIterator", "PrefetchingIterator",
+           "token_batch_fetcher"]
+
+# epoch-key mixing: a fixed odd multiplier keeps (seed, epoch) streams
+# distinct without wall-clock entropy; modulo keeps RandomState's u32 seed
+_EPOCH_MIX = 1_000_003
+
+
+class ShardedIndexIterator:
+    """Seeded, seekable per-host index sampler.
+
+    ``next()`` yields this host's ``global_batch // num_hosts`` row
+    indices for the next global batch. ``drop_last`` semantics: each
+    epoch uses the first ``batches_per_epoch * global_batch`` rows of its
+    permutation; the remainder is dropped (never silently wrapped).
+    """
+
+    def __init__(self, total_samples: int, global_batch: int, *,
+                 seed: int, host_id: int = 0, num_hosts: int = 1,
+                 shuffle: bool = True):
+        if global_batch < 1 or total_samples < global_batch:
+            raise ValueError(
+                f"need total_samples >= global_batch >= 1, got "
+                f"{total_samples} / {global_batch}")
+        if num_hosts < 1 or not 0 <= host_id < num_hosts:
+            raise ValueError(f"bad host grid {host_id}/{num_hosts}")
+        if global_batch % num_hosts:
+            raise ValueError(
+                f"global_batch {global_batch} not divisible by num_hosts "
+                f"{num_hosts}")
+        self.total_samples = int(total_samples)
+        self.global_batch = int(global_batch)
+        self.seed = int(seed)
+        self.host_id = int(host_id)
+        self.num_hosts = int(num_hosts)
+        self.shuffle = bool(shuffle)
+        self.batches_per_epoch = self.total_samples // self.global_batch
+        self.consumed = 0  # batches handed out so far == the cursor
+        self._perm_epoch: Optional[int] = None
+        self._perm: Optional[np.ndarray] = None
+
+    def _epoch_perm(self, epoch: int) -> np.ndarray:
+        if self._perm_epoch != epoch:
+            if self.shuffle:
+                rs = np.random.RandomState(
+                    (self.seed + _EPOCH_MIX * (epoch + 1)) % (2 ** 32))
+                self._perm = rs.permutation(self.total_samples)
+            else:
+                self._perm = np.arange(self.total_samples)
+            self._perm_epoch = epoch
+        return self._perm
+
+    def batch_indices(self, k: int) -> np.ndarray:
+        """This host's row indices for global batch ``k`` — pure in
+        ``(seed, k)``, the property resume correctness rests on."""
+        if k < 0:
+            raise ValueError(f"batch number must be >= 0, got {k}")
+        epoch, b = divmod(k, self.batches_per_epoch)
+        rows = self._epoch_perm(epoch)[b * self.global_batch:
+                                       (b + 1) * self.global_batch]
+        per_host = self.global_batch // self.num_hosts
+        return rows[self.host_id * per_host:(self.host_id + 1) * per_host]
+
+    def __iter__(self) -> "ShardedIndexIterator":
+        return self
+
+    def __next__(self) -> np.ndarray:
+        out = self.batch_indices(self.consumed)
+        self.consumed += 1
+        return out
+
+    # -- resume -----------------------------------------------------------
+    def state_dict(self) -> Dict[str, int]:
+        return {"consumed": int(self.consumed), "seed": self.seed}
+
+    def load_state_dict(self, state: Dict[str, int]) -> None:
+        seed = state.get("seed")
+        if seed is not None and int(seed) != self.seed:
+            raise ValueError(
+                f"data cursor was saved under seed {seed} but this "
+                f"iterator is seeded with {self.seed}; resuming would "
+                f"replay a different stream")
+        self.consumed = int(state["consumed"])
+
+
+class PrefetchingIterator:
+    """Double-buffered (``depth``-deep) device prefetch over a sampler.
+
+    ``fetch(indices) -> host batch pytree``; each fetched batch is
+    ``jax.device_put`` (with ``sharding`` when given — pass the batch's
+    ``NamedSharding`` so shards land on their owners) as soon as it is
+    produced, ``depth`` batches ahead of consumption. ``state_dict`` /
+    ``load_state_dict`` expose the *consumed* cursor; loading clears the
+    prefetch buffer and seeks the sampler, so the next ``next()`` after a
+    restore yields exactly the batch the interrupted run would have
+    consumed.
+    """
+
+    def __init__(self, sampler: ShardedIndexIterator,
+                 fetch: Callable[[np.ndarray], Any], *,
+                 depth: int = 2, sharding: Any = None):
+        if depth < 1:
+            raise ValueError("prefetch depth must be >= 1")
+        self.sampler = sampler
+        self.fetch = fetch
+        self.depth = depth
+        self.sharding = sharding
+        self.consumed = 0
+        self._buf: deque = deque()
+
+    def _put(self, batch: Any) -> Any:
+        if self.sharding is None:
+            return jax.tree_util.tree_map(jax.device_put, batch)
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, self.sharding), batch)
+
+    def _fill(self) -> None:
+        while len(self._buf) < self.depth:
+            self._buf.append(self._put(self.fetch(next(self.sampler))))
+
+    def __iter__(self) -> "PrefetchingIterator":
+        return self
+
+    def __next__(self) -> Any:
+        self._fill()
+        batch = self._buf.popleft()
+        self.consumed += 1
+        self._fill()  # keep the pipeline primed while the step runs
+        return batch
+
+    # -- resume -----------------------------------------------------------
+    def state_dict(self) -> Dict[str, int]:
+        # the CONSUMED cursor: prefetched-but-unconsumed batches are
+        # in-flight state the restore deliberately refetches
+        return {"consumed": int(self.consumed), "seed": self.sampler.seed}
+
+    def load_state_dict(self, state: Dict[str, int]) -> None:
+        self.sampler.load_state_dict(state)  # seeks sampler.consumed too
+        self.consumed = int(state["consumed"])
+        self._buf.clear()
+
+
+def token_batch_fetcher(data: np.ndarray, num_micro: int, rows: int,
+                        seq: int) -> Callable[[np.ndarray], Any]:
+    """Fetch closure for the GPT trainer: gathers ``num_micro * rows``
+    dataset rows of length ``seq + 1`` and splits them into the
+    ``(tokens, targets)`` pair of ``(num_micro, rows, seq)`` arrays the
+    hybrid step consumes (next-token targets = the same rows shifted)."""
+    data = np.asarray(data)
+    if data.ndim != 2 or data.shape[1] < seq + 1:
+        raise ValueError(
+            f"dataset must be (N, >={seq + 1}), got {data.shape}")
+
+    def fetch(indices: np.ndarray) -> Any:
+        if len(indices) != num_micro * rows:
+            raise ValueError(
+                f"fetch got {len(indices)} indices, expected "
+                f"{num_micro} * {rows}")
+        chunk = np.take(data, indices, axis=0)[:, :seq + 1]
+        chunk = chunk.reshape(num_micro, rows, seq + 1)
+        return chunk[..., :-1], chunk[..., 1:]
+
+    return fetch

@@ -1,0 +1,127 @@
+"""Deterministic fault injection for the elastic runtime.
+
+A :class:`FaultPlan` scripts *when* and *how* a training run fails, so
+preemption and filesystem faults are a tested path, not a hope:
+
+- ``sigterm_at_step=K`` — deliver a real ``SIGTERM`` to this process
+  right before step ``K`` runs (the Cloud-TPU preemption signal; the
+  installed :class:`~apex_tpu.utils.autoresume.AutoResume` handler
+  latches it and the runner drains + saves + exits inside the grace
+  window).
+- ``save_errors={step: n}`` — raise ``n`` transient ``OSError``\\ s from
+  the first ``n`` serialization attempts of the checkpoint at ``step``,
+  exercising the :class:`~apex_tpu.elastic.ckpt.AsyncCheckpointer`
+  bounded retry-with-backoff.
+- ``tear_after_step=K`` — after the checkpoint at step ``K`` commits,
+  remove its COMMITTED marker: the on-disk picture of a writer killed
+  mid-save. Restore must fall back to the previous COMMITTED step, with
+  a warning naming the torn one.
+- ``slow_save_s=t`` — stretch every serialization by ``t`` seconds, to
+  widen the in-flight window deterministically (so a preemption reliably
+  lands while a save is being written).
+
+Plans are *explicitly seeded* and fully serializable: :meth:`sample`
+derives one from an integer seed via ``numpy.random.RandomState`` (no
+wall-clock entropy anywhere), and :meth:`to_json` / :meth:`from_json`
+carry a plan across a process boundary (the kill-and-resume subprocess
+tests hand the child its plan on the command line).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["FaultPlan"]
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """A scripted failure schedule. All fields optional; an empty plan
+    injects nothing (every hook is a no-op)."""
+
+    sigterm_at_step: Optional[int] = None
+    save_errors: Dict[int, int] = dataclasses.field(default_factory=dict)
+    tear_after_step: Optional[int] = None
+    slow_save_s: float = 0.0
+    seed: Optional[int] = None  # provenance when built via sample()
+
+    # -- injection hooks --------------------------------------------------
+    def before_step(self, step: int) -> None:
+        """Runner hook, called before step ``step`` executes. Delivers
+        the scripted SIGTERM to *this* process — through the real signal
+        machinery, so the AutoResume handler path is the one exercised."""
+        if self.sigterm_at_step is not None and step == self.sigterm_at_step:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def on_save_attempt(self, step: int, attempt: int) -> None:
+        """:class:`~apex_tpu.elastic.ckpt.AsyncCheckpointer` fault hook:
+        called before serialization attempt ``attempt`` (0-based) of the
+        checkpoint at ``step``."""
+        if self.slow_save_s > 0.0:
+            time.sleep(self.slow_save_s)
+        if attempt < int(self.save_errors.get(step, 0)):
+            raise OSError(
+                f"injected transient save fault (step {step}, attempt "
+                f"{attempt})")
+
+    def after_save(self, step: int, path: str) -> None:
+        """Post-commit hook: tears the scripted checkpoint by removing
+        its COMMITTED marker (simulating a writer killed between the
+        array write and the commit)."""
+        if self.tear_after_step is not None and step == self.tear_after_step:
+            from apex_tpu.checkpoint import _COMMIT_FILE
+            marker = os.path.join(path, _COMMIT_FILE)
+            if os.path.exists(marker):
+                os.remove(marker)
+
+    # -- construction / transport ----------------------------------------
+    @classmethod
+    def sample(cls, seed: int, total_steps: int, *,
+               save_interval: int = 1, transient_errors: bool = True,
+               tear: bool = False) -> "FaultPlan":
+        """Derive a plan deterministically from ``seed``: one preemption
+        at a uniform step in ``[1, total_steps)``, optionally 1-2
+        transient save errors, optionally tearing the preemption-time
+        checkpoint.
+
+        ``save_interval`` must match the runner's: the error step is
+        snapped to a step at which a save actually happens (a multiple of
+        the interval ≤ the preemption step, else the preemption save
+        itself) — an error keyed to a never-saved step would silently
+        inject nothing and the retry path would go untested.
+        """
+        if total_steps < 2:
+            raise ValueError("total_steps must be >= 2 to place a fault")
+        if save_interval < 1:
+            raise ValueError("save_interval must be >= 1")
+        rs = np.random.RandomState(seed)
+        k = int(rs.randint(1, total_steps))
+        plan = cls(sigterm_at_step=k, seed=int(seed))
+        if transient_errors:
+            save_steps = list(range(save_interval, k + 1, save_interval))
+            if not save_steps:
+                save_steps = [k]  # only the preemption save exists
+            plan.save_errors = {int(rs.choice(save_steps)):
+                                int(rs.randint(1, 3))}
+        if tear:
+            plan.tear_after_step = k
+        return plan
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        d["save_errors"] = {str(k): v for k, v in self.save_errors.items()}
+        return json.dumps(d)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        d = json.loads(text)
+        d["save_errors"] = {int(k): int(v)
+                            for k, v in d.get("save_errors", {}).items()}
+        return cls(**d)

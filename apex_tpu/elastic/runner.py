@@ -1,0 +1,210 @@
+"""Preemption-safe elastic run loop over :class:`GPTHybridTrainer`.
+
+``ElasticRunner`` owns the production step loop: periodic async
+checkpoints off the critical path, SIGTERM/env/hook termination detection
+through :class:`~apex_tpu.utils.autoresume.AutoResume`, drain-then-save
+inside the preemption grace window, and deterministic restart — restore
+the latest COMMITTED checkpoint (params, optimizer state incl. the ZeRO
+``bucket_stamp``-guarded flat shards, loss-scale), seek the data iterator
+to the sidecar cursor, and continue. The contract, proven in
+``tests/test_elastic_resume.py`` and the dryrun kill-and-resume leg:
+
+    N steps + preempt + restore + M steps  ==  N+M straight steps,
+    bitwise, for params, optimizer state, loss scale, and data cursor.
+
+The trainer protocol is :class:`~apex_tpu.training.GPTHybridTrainer`'s
+surface: ``init_state(key) -> state tuple``, ``jit_train_step() ->
+fn(*state, *batch) -> (loss, *state)``; the data protocol is
+``next(data) -> batch tuple`` plus ``state_dict()/load_state_dict()``
+(see :mod:`apex_tpu.elastic.data`).
+
+Exit discipline: the ONLY process exit in this package is
+``AutoResume.request_resume`` (enforced statically by
+``scripts/check_elastic_exits.py``) — every other failure propagates as
+an exception the scheduler can distinguish from a clean preemption.
+
+Metrics (host registry): ``resume/restore_ms``, ``resume/restored_step``
+(gauges), ``resume/resumes``, ``resume/preempt_exits`` (counters), plus
+the ``ckpt/*`` family from :class:`~apex_tpu.elastic.ckpt
+.AsyncCheckpointer`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Optional
+
+import jax
+
+from apex_tpu import checkpoint as _ckpt
+from apex_tpu.elastic.ckpt import AsyncCheckpointer, owned_copy
+from apex_tpu.elastic.faults import FaultPlan
+from apex_tpu.observability.registry import MetricsRegistry, get_registry
+from apex_tpu.utils.autoresume import AutoResume
+
+__all__ = ["ElasticRunner", "FitResult"]
+
+
+@dataclasses.dataclass
+class FitResult:
+    """What a (possibly interrupted) :meth:`ElasticRunner.fit` produced."""
+
+    state: Any                    # trainer state tuple after the last step
+    step: int                     # completed steps
+    loss: Optional[float]         # last step's loss (None if no step ran)
+    preempted: bool               # True: stopped on a termination request
+    restored_from: Optional[int]  # checkpoint step this run resumed from
+
+
+class ElasticRunner:
+    """Elastic training loop: checkpoint cadence + preemption handling.
+
+    ``directory`` is the checkpoint root. ``save_interval=K`` checkpoints
+    every K completed steps (asynchronously — the loop never blocks on
+    disk); ``keep_last`` bounds the on-disk generations. ``fault_plan``
+    wires a :class:`~apex_tpu.elastic.faults.FaultPlan` into both the
+    step loop and the checkpointer. ``exit_on_preempt=False`` makes a
+    preemption return a ``FitResult(preempted=True)`` instead of calling
+    ``AutoResume.request_resume`` (in-process tests; production keeps the
+    exit-0-so-the-scheduler-restarts default).
+    """
+
+    def __init__(self, trainer: Any, data: Any, directory: str, *,
+                 save_interval: int = 50, keep_last: Optional[int] = 3,
+                 fp32_on_disk: bool = True,
+                 autoresume: Optional[AutoResume] = None,
+                 fault_plan: Optional[FaultPlan] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 exit_on_preempt: bool = True, final_save: bool = True,
+                 on_step: Optional[Callable[[int, Any], None]] = None,
+                 checkpointer: Optional[AsyncCheckpointer] = None):
+        if save_interval < 1:
+            raise ValueError("save_interval must be >= 1")
+        self.trainer = trainer
+        self.data = data
+        self.directory = directory
+        self.save_interval = save_interval
+        self.fault_plan = fault_plan
+        self.autoresume = autoresume
+        self.exit_on_preempt = exit_on_preempt
+        self.final_save = final_save
+        self.on_step = on_step
+        self._registry = (registry if registry is not None
+                          else get_registry())
+        self.ckpt = checkpointer if checkpointer is not None else \
+            AsyncCheckpointer(
+                directory, fp32_on_disk=fp32_on_disk, keep_last=keep_last,
+                registry=self._registry,
+                fault_hook=(fault_plan.on_save_attempt if fault_plan
+                            else None),
+                after_save=(fault_plan.after_save if fault_plan else None))
+
+    # -- sidecar ----------------------------------------------------------
+    def _host_state(self, step: int) -> dict:
+        host = {"step": int(step)}
+        if self.data is not None and hasattr(self.data, "state_dict"):
+            host["data"] = self.data.state_dict()
+        return host
+
+    def _restore(self, state: tuple) -> tuple:
+        """Latest-COMMITTED restore onto the live state's layout; returns
+        ``(state, completed_steps, restored_from)``."""
+        latest = _ckpt.latest_step(self.directory)
+        if latest is None:
+            # still warn about torn dirs a dead writer left behind
+            torn = _ckpt.torn_steps(self.directory)
+            if torn:
+                import warnings
+                warnings.warn(
+                    f"no committed checkpoint under {self.directory!r}; "
+                    f"ignoring torn dir(s) at step(s) {torn} and starting "
+                    "from scratch")
+            return state, 0, None
+        t0 = time.perf_counter()
+        restored, host = _ckpt.restore_checkpoint(self.directory, state)
+        self._registry.gauge("resume/restore_ms").set(
+            (time.perf_counter() - t0) * 1e3)
+        step = int(host.get("step", latest))
+        self._registry.gauge("resume/restored_step").set(step)
+        self._registry.counter("resume/resumes").inc()
+        if (self.data is not None and "data" in host
+                and hasattr(self.data, "load_state_dict")):
+            self.data.load_state_dict(host["data"])
+        # the restored step IS durably on disk — mark it saved, so a fit
+        # that runs zero further steps (restart after completion, or a
+        # preemption landing immediately) does not re-save it:
+        # save_checkpoint rmtree's the existing dir before rewriting, and
+        # a kill in that window would destroy the newest (with
+        # keep_last=1, the only) COMMITTED checkpoint
+        self.ckpt.last_saved_step = step
+        # materialize XLA-owned buffers before the state can be DONATED:
+        # orbax-restored arrays may alias host memory the runtime does not
+        # own, and jit_train_step's donate_argnums would free/reuse it
+        # under the allocator's feet (see elastic/ckpt.owned_copy)
+        return tuple(owned_copy(restored)), step, step
+
+    # -- preemption -------------------------------------------------------
+    def _preempt(self, ar: AutoResume, state: tuple, step: int,
+                 loss: Any, restored_from: Optional[int]) -> FitResult:
+        """The grace-window path: drain the in-flight save, write a final
+        checkpoint at the current completed step, then hand control back
+        to the scheduler (exit 0 via ``request_resume``)."""
+        self.ckpt.drain()
+        if self.ckpt.last_saved_step != step:
+            self.ckpt.save(state, step, host_state=self._host_state(step),
+                           block=True)
+        self._registry.counter("resume/preempt_exits").inc()
+        if self.exit_on_preempt:
+            ar.request_resume()  # sys.exit(0): scheduler restarts the job
+        return FitResult(state=state, step=step,
+                         loss=None if loss is None else float(loss),
+                         preempted=True, restored_from=restored_from)
+
+    # -- the loop ---------------------------------------------------------
+    def fit(self, steps: int, *, key: Optional[jax.Array] = None,
+            state: Optional[tuple] = None) -> FitResult:
+        """Run until ``steps`` total steps are COMPLETED (counting the
+        restored prefix), checkpointing on the way. ``state`` overrides
+        the freshly-initialized state used as the restore target (its
+        shapes/dtypes/shardings define the checkpoint layout)."""
+        if state is None:
+            state = self.trainer.init_state(
+                key if key is not None else jax.random.PRNGKey(0))
+        state, step, restored_from = self._restore(tuple(state))
+        ar = self.autoresume
+        own_ar = ar is None
+        if own_ar:
+            ar = AutoResume(interval=1)
+        step_fn = self.trainer.jit_train_step()
+        loss = None
+        try:
+            while step < steps:
+                if self.fault_plan is not None:
+                    self.fault_plan.before_step(step)
+                if ar.termination_requested(step):
+                    return self._preempt(ar, state, step, loss,
+                                         restored_from)
+                batch = next(self.data)
+                loss, *state = step_fn(*state, *batch)
+                state = tuple(state)
+                step += 1
+                if self.on_step is not None:
+                    self.on_step(step, loss)
+                if step % self.save_interval == 0 and step < steps:
+                    self.ckpt.save(state, step,
+                                   host_state=self._host_state(step))
+            # run complete: drain the tail save, then commit the final one
+            self.ckpt.drain()
+            if ar.termination_requested(step):
+                return self._preempt(ar, state, step, loss, restored_from)
+            if self.final_save and self.ckpt.last_saved_step != step:
+                self.ckpt.save(state, step,
+                               host_state=self._host_state(step),
+                               block=True)
+            return FitResult(state=state, step=step,
+                             loss=None if loss is None else float(loss),
+                             preempted=False, restored_from=restored_from)
+        finally:
+            if own_ar:
+                ar.close()

@@ -38,7 +38,14 @@ class AutoResume:
     ``hook``: optional callable returning True when the scheduler wants
     the job to stop (the role of ADLR's ``AutoResume.termination_
     requested``); the ``APEX_TPU_TERMINATE`` env var (any non-empty
-    value) and SIGTERM are always honored.
+    value — whitespace-only strings count, only the empty string and an
+    unset var do not) and SIGTERM are always honored.
+
+    Every source LATCHES: once SIGTERM arrives, the env var reads
+    non-empty, or the hook returns True on any polled step, the request
+    is permanent for this instance — a hook that fires once at step K
+    and then returns False at K+1 (or an env var cleared between polls)
+    cannot lose the termination request.
     """
 
     def __init__(self, interval: int = 1,
@@ -69,9 +76,16 @@ class AutoResume:
             return True
         if step is not None and step % self.interval:
             return False
-        if os.environ.get("APEX_TPU_TERMINATE"):
+        # "any non-empty" contract: a whitespace-only value is a request;
+        # only unset / empty-string is not
+        if os.environ.get("APEX_TPU_TERMINATE", "") != "" or (
+                self.hook is not None and bool(self.hook())):
+            # latch: a hook that returns True once at step K then False at
+            # K+1 (or an env var cleared between polls) must not lose the
+            # request — the next poll may be an interval-off step
+            self._flag.set()
             return True
-        return bool(self.hook()) if self.hook is not None else False
+        return False
 
     def close(self) -> None:
         """Restore the previous SIGTERM handler. Call (or use the instance

@@ -51,6 +51,20 @@ def main(argv=None):
                          "registry-tagged GEMM/flash outputs resident "
                          "and recomputes only the LN/gelu tier "
                          "(docs/PERF.md 'Remat & HBM')")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="enable the elastic runtime "
+                         "(apex_tpu/elastic/): async checkpoints to this "
+                         "dir every --save-interval steps, SIGTERM/"
+                         "APEX_TPU_TERMINATE preemption handling (drain "
+                         "+ final save + exit 0), and automatic bitwise "
+                         "resume from the latest COMMITTED checkpoint "
+                         "(docs/ROBUSTNESS.md)")
+    ap.add_argument("--keep-last", type=int, default=3,
+                    help="checkpoint GC depth: keep the newest N "
+                         "COMMITTED checkpoints (torn dirs are never "
+                         "GC'd)")
+    ap.add_argument("--save-interval", type=int, default=2,
+                    help="steps between async checkpoints")
     ap.add_argument("--sequence-parallel", action="store_true",
                     help="Megatron-LM sequence parallelism (tp > 1, "
                          "pp == 1, VMA jax — the trainer refuses on the "
@@ -87,14 +101,39 @@ def main(argv=None):
     trainer = GPTHybridTrainer(cfg, mesh)
     calc = cfg.build_microbatch_calculator(dp)
     assert calc.get() == M
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, args.vocab, (10_000, seq + 1))
+
+    if args.checkpoint_dir:
+        # elastic path: seeded resumable sharded data + async checkpoints
+        # + preemption-safe loop; restart the same command line to resume
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from apex_tpu.elastic import (ElasticRunner, PrefetchingIterator,
+                                      ShardedIndexIterator,
+                                      token_batch_fetcher)
+        it = PrefetchingIterator(
+            ShardedIndexIterator(10_000, M * dp * mb, seed=0),
+            token_batch_fetcher(data, M, dp * mb, seq), depth=2,
+            sharding=NamedSharding(mesh, P(None, "data")))
+        try:
+            runner = ElasticRunner(
+                trainer, it, args.checkpoint_dir,
+                save_interval=args.save_interval,
+                keep_last=args.keep_last,
+                on_step=lambda k, loss: print(f"step {k}: loss "
+                                              f"{float(loss):.4f}"))
+            res = runner.fit(args.steps, key=jax.random.PRNGKey(0))
+        finally:
+            parallel_state.destroy_model_parallel()
+        return res.loss
+
     state = list(trainer.init_state(jax.random.PRNGKey(0)))
 
     # Megatron sampler drives the host data order
     sampler = cfg.build_sampler(total_samples=10_000, consumed_samples=0,
                                 data_parallel_rank=0, data_parallel_size=1,
                                 shuffle=True)
-    rng = np.random.RandomState(0)
-    data = rng.randint(0, args.vocab, (10_000, seq + 1))
     batches = iter(sampler)
 
     # donated jit: stage/shared/opt_state update in place — the loop below

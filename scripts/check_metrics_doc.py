@@ -38,12 +38,14 @@ DOC = os.path.join("docs", "OBSERVABILITY.md")
 # metric families under the documentation contract; names outside these
 # prefixes (host registry internals, ad-hoc example metrics) are exempt
 PREFIXES = ("health/", "tp/", "amp/", "ddp/", "pipeline/", "optim/",
-            "zero/", "mem/", "perf/")
+            "zero/", "mem/", "perf/", "ckpt/", "resume/")
 
 # callees whose literal first argument is a metric name: in-graph
-# ``ingraph.record(...)`` and host-registry ``registry.gauge(...)`` (the
-# mem/* family is static per compile, so it rides gauges, not records)
-CALLEES = ("record", "gauge")
+# ``ingraph.record(...)`` and the host-registry accessors — ``gauge``
+# (the mem/* family is static per compile, so it rides gauges, not
+# records) plus ``counter``/``histogram``, which the elastic runtime's
+# ckpt/* and resume/* families ride
+CALLEES = ("record", "gauge", "counter", "histogram")
 
 _PLACEHOLDER = re.compile(r"<[^<>`]*>")
 

@@ -1,0 +1,133 @@
+"""Subprocess child for the elastic kill-and-resume e2e tests.
+
+Runs the real :class:`~apex_tpu.training.GPTHybridTrainer` under
+:class:`~apex_tpu.elastic.runner.ElasticRunner` on its own virtual
+2-device CPU mesh (tp=1, pp=1, dp=2) and prints machine-readable
+progress lines:
+
+- ``STEP <k>`` after each completed step (the parent keys external
+  SIGTERM delivery on these),
+- ``RESTORED <n>`` when the run resumed from a checkpoint,
+- ``DIGEST <hex>`` when the run COMPLETES all steps: a sha256 over the
+  bitwise content of every state leaf (params, optimizer moments,
+  loss-scale scalars) plus the completed-step count and the data
+  cursor — the equality the bitwise-resume contract is judged on.
+
+A run preempted mid-way (external ``kill -TERM`` or a
+:class:`~apex_tpu.elastic.faults.FaultPlan` self-SIGTERM) drains the
+in-flight save, writes a final checkpoint, and exits 0 via
+``AutoResume.request_resume`` — so it never prints ``DIGEST``; the
+parent relaunches the same command line and the resumed run finishes
+the remaining steps. The parent also imports this module directly to
+produce the uninterrupted reference digest in-process (one source for
+the model/data recipe, so child and reference cannot drift).
+"""
+
+import argparse
+import hashlib
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# tiny-but-real hybrid GPT: tp=1 pp=1 dp=2 on 2 virtual CPU devices
+VOCAB, HIDDEN, LAYERS, HEADS, SEQ = 32, 16, 1, 2, 8
+M, MB = 2, 1  # microbatches x micro-batch rows (per dp rank)
+DATA_ROWS, DATA_SEED = 64, 1
+
+
+def build_trainer_and_data(devices):
+    """(trainer, data_iterator, mesh) on the FIRST ``len(devices)`` of the
+    caller's jax devices — shared by the child (2-device process) and the
+    parent's in-process reference run (first 2 of its 8)."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from apex_tpu.config import (BatchConfig, ModelConfig, OptimizerConfig,
+                                 ParallelConfig, TrainConfig)
+    from apex_tpu.elastic import (PrefetchingIterator, ShardedIndexIterator,
+                                  token_batch_fetcher)
+    from apex_tpu.training import GPTHybridTrainer
+
+    dp = len(devices)
+    cfg = TrainConfig(
+        model=ModelConfig(name="gpt", vocab_size=VOCAB, hidden_size=HIDDEN,
+                          num_layers=LAYERS, num_attention_heads=HEADS,
+                          max_position_embeddings=SEQ),
+        parallel=ParallelConfig(tensor_model_parallel_size=1,
+                                pipeline_model_parallel_size=1),
+        batch=BatchConfig(global_batch_size=M * MB * dp,
+                          micro_batch_size=MB),
+        optimizer=OptimizerConfig(name="adam", lr=1e-2, weight_decay=0.0),
+        opt_level="O0")
+    mesh = cfg.initialize_mesh(devices=devices)
+    trainer = GPTHybridTrainer(cfg, mesh)
+
+    data = np.random.RandomState(0).randint(0, VOCAB, (DATA_ROWS, SEQ + 1))
+    sampler = ShardedIndexIterator(DATA_ROWS, M * dp * MB, seed=DATA_SEED)
+    fetch = token_batch_fetcher(data, M, dp * MB, SEQ)
+    it = PrefetchingIterator(
+        sampler, fetch, depth=2,
+        sharding=NamedSharding(mesh, P(None, "data")))
+    return trainer, it, mesh
+
+
+def state_digest(state, step, cursor):
+    """sha256 of the bitwise content of every leaf + step + data cursor."""
+    import jax
+    import numpy as np
+
+    from apex_tpu.elastic.ckpt import host_snapshot
+
+    h = hashlib.sha256()
+    h.update(f"step={int(step)};cursor={int(cursor)};".encode())
+    for leaf in jax.tree_util.tree_leaves(host_snapshot(state)):
+        arr = np.asarray(leaf)
+        h.update(str(arr.dtype).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--fp32-on-disk", type=int, default=1)
+    ap.add_argument("--fault-json", default=None)
+    ap.add_argument("--save-interval", type=int, default=1)
+    args = ap.parse_args(argv)
+
+    from apex_tpu.utils.hostmesh import force_virtual_cpu_devices
+    force_virtual_cpu_devices(2)
+    import jax
+
+    # match the parent test process (tests/conftest.py) so the in-process
+    # reference digest and the child digests are comparable
+    jax.config.update("jax_threefry_partitionable", True)
+
+    from apex_tpu.elastic import ElasticRunner, FaultPlan
+    from apex_tpu.transformer import parallel_state
+
+    plan = (FaultPlan.from_json(args.fault_json)
+            if args.fault_json else None)
+    trainer, it, _ = build_trainer_and_data(jax.devices()[:2])
+    try:
+        runner = ElasticRunner(
+            trainer, it, args.ckpt_dir,
+            save_interval=args.save_interval, keep_last=3,
+            fp32_on_disk=bool(args.fp32_on_disk), fault_plan=plan,
+            on_step=lambda k, _loss: print(f"STEP {k}", flush=True))
+        res = runner.fit(args.steps, key=jax.random.PRNGKey(0))
+        if res.restored_from is not None:
+            print(f"RESTORED {res.restored_from}", flush=True)
+        print(f"DIGEST {state_digest(res.state, res.step, it.consumed)}",
+              flush=True)
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""AutoResume termination-detection tests (previously zero coverage).
+
+Covers the latching contract (SIGTERM, env var, and hook requests are
+permanent once seen — a hook that fires once at step K then returns False
+at K+1 must not lose the request), the ``--adlr-autoresume-interval``
+polling semantics, SIGTERM handler chaining + ``close()`` restore, and
+context-manager use.
+"""
+
+import os
+import signal
+
+import pytest
+
+from apex_tpu.utils.autoresume import AutoResume
+
+
+@pytest.fixture
+def clean_env(monkeypatch):
+    monkeypatch.delenv("APEX_TPU_TERMINATE", raising=False)
+
+
+def test_sigterm_latches(clean_env):
+    with AutoResume(interval=1) as ar:
+        assert not ar.termination_requested()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert ar.termination_requested()
+        # latched: every later poll (any step) keeps reporting it
+        assert ar.termination_requested(step=3)
+
+
+def test_env_var_any_nonempty_and_latch(clean_env, monkeypatch):
+    with AutoResume(interval=1, install_sigterm_handler=False) as ar:
+        monkeypatch.setenv("APEX_TPU_TERMINATE", "")
+        assert not ar.termination_requested()  # empty string: no request
+        monkeypatch.setenv("APEX_TPU_TERMINATE", " ")  # whitespace-only
+        assert ar.termination_requested()      # "any non-empty" contract
+        # latched even after the variable is cleared again
+        monkeypatch.delenv("APEX_TPU_TERMINATE")
+        assert ar.termination_requested()
+
+
+def test_hook_polled_on_interval_only(clean_env):
+    calls = []
+
+    def hook():
+        calls.append(1)
+        return False
+
+    with AutoResume(interval=5, hook=hook,
+                    install_sigterm_handler=False) as ar:
+        for step in range(1, 10):
+            ar.termination_requested(step)
+        # polled at step 5 only; 1-4 and 6-9 are interval-off steps
+        assert len(calls) == 1
+        ar.termination_requested()  # stepless poll always asks
+        assert len(calls) == 2
+
+
+def test_hook_firing_once_is_latched(clean_env):
+    fired = iter([True])
+
+    def hook():
+        return next(fired, False)  # True exactly once, then False forever
+
+    with AutoResume(interval=1, hook=hook,
+                    install_sigterm_handler=False) as ar:
+        assert ar.termination_requested(step=4)
+        # the hook now answers False — the latched flag must survive
+        assert ar.termination_requested(step=5)
+        assert ar.termination_requested()
+
+
+def test_handler_chaining_and_close_restores(clean_env):
+    seen = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
+    try:
+        ar = AutoResume(interval=1)
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert ar.termination_requested()
+        # the pre-existing handler was chained, not swallowed
+        assert seen == [signal.SIGTERM]
+        ar.close()
+        # close() reinstalled the previous handler
+        assert signal.getsignal(signal.SIGTERM) is not ar._on_sigterm
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert seen == [signal.SIGTERM, signal.SIGTERM]
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_context_manager_restores_handler(clean_env):
+    before = signal.getsignal(signal.SIGTERM)
+    with AutoResume(interval=1) as ar:
+        assert signal.getsignal(signal.SIGTERM) == ar._on_sigterm
+    assert signal.getsignal(signal.SIGTERM) == before
+
+
+def test_interval_validation(clean_env):
+    with pytest.raises(ValueError):
+        AutoResume(interval=0)

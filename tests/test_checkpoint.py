@@ -221,3 +221,45 @@ def test_restore_missing_and_uncommitted(tmp_path):
     import os
     os.remove(os.path.join(path, "COMMITTED"))
     assert latest_step(str(tmp_path)) is None
+
+
+def test_restore_skips_torn_dir_with_warning(tmp_path):
+    """A torn dir NEWER than the latest COMMITTED step (a writer died
+    mid-save) is skipped loudly: latest-step restore warns naming the
+    skipped step and falls back to the committed one."""
+    import os
+
+    from apex_tpu.checkpoint import torn_steps
+
+    save_checkpoint(str(tmp_path), {"x": jnp.full(2, 1.0)}, step=1)
+    path2 = save_checkpoint(str(tmp_path), {"x": jnp.full(2, 2.0)}, step=2)
+    os.remove(os.path.join(path2, "COMMITTED"))
+    assert torn_steps(str(tmp_path)) == [2]
+    with pytest.warns(UserWarning, match=r"torn.*\[2\]"):
+        restored, host = restore_checkpoint(str(tmp_path),
+                                            {"x": jnp.zeros(2)})
+    np.testing.assert_array_equal(np.asarray(restored["x"]), [1.0, 1.0])
+
+
+def test_restore_with_only_torn_dirs_names_them(tmp_path):
+    import os
+
+    path = save_checkpoint(str(tmp_path), {"x": jnp.zeros(1)}, step=3)
+    os.remove(os.path.join(path, "COMMITTED"))
+    with pytest.warns(UserWarning, match="torn"):
+        with pytest.raises(FileNotFoundError, match=r"torn.*\[3\]"):
+            restore_checkpoint(str(tmp_path), {"x": jnp.zeros(1)})
+
+
+def test_keep_last_is_canonical_keep_spelling(tmp_path):
+    for s in (1, 2, 3):
+        save_checkpoint(str(tmp_path), {"x": jnp.zeros(2)}, step=s,
+                        keep_last=2)
+    assert all_steps(str(tmp_path)) == [2, 3]
+    # conflicting double spelling is rejected
+    with pytest.raises(ValueError, match="keep_last"):
+        save_checkpoint(str(tmp_path), {"x": jnp.zeros(2)}, step=4,
+                        keep=1, keep_last=2)
+    with pytest.raises(ValueError):
+        save_checkpoint(str(tmp_path), {"x": jnp.zeros(2)}, step=4,
+                        keep_last=0)

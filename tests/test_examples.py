@@ -47,6 +47,25 @@ def test_gpt_pretrain_zero_runs():
     assert loss > 0
 
 
+def test_gpt_pretrain_elastic_checkpoint_and_resume(tmp_path):
+    """--checkpoint-dir routes the example through the elastic runtime:
+    the first invocation checkpoints as it trains, the second resumes
+    from the latest COMMITTED step and runs only the remaining steps."""
+    import gpt_pretrain
+
+    from apex_tpu.checkpoint import all_steps, latest_step
+
+    args = ["--tp", "2", "--pp", "2", "--checkpoint-dir", str(tmp_path),
+            "--save-interval", "1", "--keep-last", "2"]
+    loss = gpt_pretrain.main(args + ["--steps", "2"])
+    assert np.isfinite(loss)
+    assert latest_step(str(tmp_path)) == 2
+    assert len(all_steps(str(tmp_path))) <= 2  # keep_last GC bound
+    loss2 = gpt_pretrain.main(args + ["--steps", "3"])
+    assert np.isfinite(loss2)
+    assert latest_step(str(tmp_path)) == 3
+
+
 def test_dcgan_amp_runs():
     import dcgan_amp
     errD, errG = dcgan_amp.main(["--steps", "3", "--batch", "8"])

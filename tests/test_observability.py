@@ -1022,7 +1022,7 @@ class TestCheckMetricsDoc:
         assert proc.returncode == 0, proc.stdout + proc.stderr
         # the known families all show up as checked
         for family in ("health/", "amp/", "ddp/", "pipeline/", "optim/",
-                       "tp/", "zero/", "perf/"):
+                       "tp/", "zero/", "perf/", "ckpt/", "resume/"):
             assert family in proc.stdout, family
 
     def _mod(self):
@@ -1068,6 +1068,34 @@ class TestCheckMetricsDoc:
         (tmp_path / "apex_tpu").mkdir()
         ok, lines = mod.check(repo=str(tmp_path))
         assert not ok and any("MISSING" in l for l in lines)
+
+    def test_detects_undocumented_ckpt_resume_counters(self, tmp_path):
+        """The elastic families ride the host-registry counter/histogram
+        accessors, not record()/gauge() — those callees are under the
+        contract too."""
+        mod = self._mod()
+        pkg = tmp_path / "apex_tpu" / "elastic"
+        pkg.mkdir(parents=True)
+        (pkg / "m.py").write_text(
+            "def f(reg, x):\n"
+            "    reg.counter('ckpt/rogue_bytes').inc(x)\n"
+            "    reg.histogram('ckpt/rogue_ms').observe(x)\n"
+            "    reg.counter('resume/rogue_count').inc()\n")
+        docs = tmp_path / "docs"
+        docs.mkdir()
+        (docs / "OBSERVABILITY.md").write_text("| nothing documented |\n")
+        ok, lines = mod.check(repo=str(tmp_path))
+        assert not ok
+        undoc = [l for l in lines if l.startswith("UNDOC")]
+        assert len(undoc) == 3
+        for name in ("ckpt/rogue_bytes", "ckpt/rogue_ms",
+                     "resume/rogue_count"):
+            assert any(name in l for l in undoc), name
+        (docs / "OBSERVABILITY.md").write_text(
+            "| `ckpt/rogue_bytes` | `ckpt/rogue_ms` | "
+            "`resume/rogue_count` |\n")
+        ok, lines = mod.check(repo=str(tmp_path))
+        assert ok, "\n".join(lines)
 
 
 # ---------------------------------------------------------------------------
@@ -1146,3 +1174,79 @@ class TestCheckRematNames:
         with pytest.raises(ValueError, match="CHECKPOINT_NAMES"):
             remat.tag(jnp.ones(3), "rogue_act")
         assert set(remat.SELECTIVE_SAVE) <= set(remat.CHECKPOINT_NAMES)
+
+
+# ---------------------------------------------------------------------------
+# elastic exit-discipline contract (process exits only through
+# AutoResume.request_resume)
+# ---------------------------------------------------------------------------
+
+class TestCheckElasticExits:
+    def test_script_passes_on_this_tree(self):
+        proc = subprocess.run(
+            [sys.executable, "scripts/check_elastic_exits.py"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "request_resume is the sole exit chokepoint" in proc.stdout
+        for mod in ("ckpt.py", "runner.py", "faults.py", "data.py"):
+            assert mod in proc.stdout, mod
+
+    def _mod(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "check_elastic_exits", "scripts/check_elastic_exits.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def _chokepoint(self, tmp_path):
+        utils = tmp_path / "apex_tpu" / "utils"
+        utils.mkdir(parents=True, exist_ok=True)
+        (utils / "autoresume.py").write_text(
+            "import sys\n"
+            "class AutoResume:\n"
+            "    def request_resume(self, exit_code=0):\n"
+            "        sys.exit(exit_code)\n")
+        (tmp_path / "apex_tpu" / "elastic").mkdir(parents=True,
+                                                  exist_ok=True)
+
+    def test_detects_every_exit_spelling(self, tmp_path):
+        mod = self._mod()
+        self._chokepoint(tmp_path)
+        bad = tmp_path / "apex_tpu" / "elastic" / "bad.py"
+        bad.write_text(
+            "import os, sys\n"
+            "def f(code):\n"
+            "    sys.exit(code)\n"
+            "    os._exit(code)\n"
+            "    exit(code)\n"
+            "    raise SystemExit(code)\n")
+        ok, lines = mod.check(repo=str(tmp_path))
+        assert not ok
+        flagged = [l for l in lines if l.startswith("EXIT")]
+        assert len(flagged) == 4
+        for spelling, lineno in (("sys.exit", 3), ("os._exit", 4),
+                                 ("exit", 5), ("raise SystemExit", 6)):
+            assert any(spelling in l and f"bad.py:{lineno}" in l
+                       for l in flagged), spelling
+        # a clean tree with the same chokepoint passes
+        bad.write_text("def f():\n    raise RuntimeError('propagate')\n")
+        ok, lines = mod.check(repo=str(tmp_path))
+        assert ok, "\n".join(lines)
+
+    def test_chokepoint_rot_is_detected(self, tmp_path):
+        """The contract anchor: if request_resume loses its sys.exit (or
+        a second exit appears in autoresume.py) the check fails."""
+        mod = self._mod()
+        self._chokepoint(tmp_path)
+        (tmp_path / "apex_tpu" / "utils" / "autoresume.py").write_text(
+            "class AutoResume:\n"
+            "    def request_resume(self, exit_code=0):\n"
+            "        pass\n")
+        ok, lines = mod.check(repo=str(tmp_path))
+        assert not ok and any(l.startswith("CHOKE") for l in lines)
+
+    def test_missing_package_fails(self, tmp_path):
+        mod = self._mod()
+        ok, lines = mod.check(repo=str(tmp_path))
+        assert not ok and any("MISSING" in l for l in lines)
