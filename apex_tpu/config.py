@@ -28,6 +28,12 @@ def _asdict(obj) -> dict:
     return dataclasses.asdict(obj)
 
 
+# optimizers with a ZeRO (DistributedFused*) variant — the single source
+# for build_optimizer's zero dispatch and fastpath()'s capability check,
+# so adding a variant cannot silently leave one of them stale
+ZERO_CAPABLE_OPTIMIZERS = ("adam", "adamw", "lamb")
+
+
 def _zero_enabled(v) -> bool:
     """Normalize ``OptimizerConfig.zero``: accepts the legacy bool plus the
     stage spelling (``"off" | 1 | "1"``) — ZeRO stage 1 (sharded optimizer
@@ -134,8 +140,13 @@ class TrainConfig:
     # engine): bytes per flat fp32 bucket for the DDP allreduce and the
     # ZeRO reduce-scatter/all-gather. None = disabled — the trainer step
     # is provably identical to the pre-bucketing program (asserted on the
-    # jaxpr, the same contract as health level="off").
-    ddp_bucket_bytes: Optional[int] = None
+    # jaxpr, the same contract as health level="off"). "auto" = resolve
+    # via the pyprof roofline (pyprof.tune_bucket_bytes: smallest bucket
+    # whose RS+AG wire time hides under the modeled backward compute);
+    # GPTHybridTrainer resolves it at construction and stores the
+    # resolved int back into its config, so checkpoints/sidecars always
+    # carry the concrete grid (the ZeRO bucket_stamp layout contract).
+    ddp_bucket_bytes: Any = None
 
     # -- serialization ----------------------------------------------------
     def to_dict(self) -> dict:
@@ -159,6 +170,66 @@ class TrainConfig:
                     sub_d["remat_names"] = tuple(sub_d["remat_names"])
                 d[field] = sub(**sub_d)
         return cls(**d)
+
+    # -- presets ----------------------------------------------------------
+    _KEEP = object()   # fastpath() sentinel: no explicit bucket override
+
+    def fastpath(self, *, bucket_bytes: Any = _KEEP) -> "TrainConfig":
+        """The flagship compound-overlap preset, one declarative config:
+        everything the overlap machinery can hide, turned on together —
+
+        - ``zero=1`` — ZeRO-1 sharded optimizer with per-bucket
+          backward-interleaved RS→math→AG chains
+          (:mod:`apex_tpu.optimizers.distributed_fused`);
+        - ``ddp_bucket_bytes`` — the bucket grid those chains pipeline
+          over; a grid already set on the receiver is KEPT (it is a
+          checkpoint-layout property), an unset one defaults to
+          ``"auto"`` (roofline-tuned,
+          :func:`apex_tpu.pyprof.tune_bucket_bytes`); pass
+          ``bucket_bytes=`` to pin it explicitly;
+        - ``remat_policy="selective"`` — GEMM/flash outputs resident,
+          only the cheap LN/gelu tier recomputed (apex_tpu/remat.py);
+        - ``sequence_parallel`` + ``tp_comm_overlap`` — ring-decomposed
+          TP collectives riding under their GEMMs — when the mesh can
+          carry them: ``tp > 1``, ``pp == 1`` (the SP head/stage
+          contract) and VMA jax (``GPTHybridTrainer`` refuses SP on the
+          pre-VMA 0.4.x line; the preset degrades to plain TP there
+          rather than constructing a trainer that would refuse).
+
+        Donation is the trainer-call half of the preset —
+        ``jit_train_step(donate=True)`` is already the default. Returns
+        a new config; the receiver is unchanged. Explicit model-level
+        SP/overlap or remat settings on the receiver are kept as-is.
+        Raises for optimizers with no ZeRO variant (sgd/novograd/...).
+        """
+        from apex_tpu.utils.compat import HAS_VMA
+        if not _zero_enabled(self.optimizer.zero) \
+                and self.optimizer.name not in ZERO_CAPABLE_OPTIMIZERS:
+            raise ValueError(
+                f"fastpath needs a ZeRO-capable optimizer "
+                f"({'|'.join(ZERO_CAPABLE_OPTIMIZERS)}), got "
+                f"{self.optimizer.name!r}")
+        tp = self.parallel.tensor_model_parallel_size
+        pp = self.parallel.pipeline_model_parallel_size
+        sp_ok = tp > 1 and pp == 1 and HAS_VMA
+        # the deprecated remat=True spelling means "full" (ModelConfig
+        # docs) — a receiver that asked for full remat keeps it; only a
+        # genuinely-unset policy defaults to selective
+        policy = self.model.remat_policy or (
+            "full" if self.model.remat else "selective")
+        model = dataclasses.replace(
+            self.model,
+            remat_policy=policy,
+            sequence_parallel=self.model.sequence_parallel or sp_ok,
+            tp_comm_overlap=self.model.tp_comm_overlap or sp_ok)
+        optimizer = (self.optimizer if _zero_enabled(self.optimizer.zero)
+                     else dataclasses.replace(self.optimizer, zero=1))
+        if bucket_bytes is TrainConfig._KEEP:
+            bucket_bytes = (self.ddp_bucket_bytes
+                            if self.ddp_bucket_bytes is not None
+                            else "auto")
+        return dataclasses.replace(self, model=model, optimizer=optimizer,
+                                   ddp_bucket_bytes=bucket_bytes)
 
     # -- builders ---------------------------------------------------------
     def build_policy(self):
@@ -226,6 +297,17 @@ class TrainConfig:
 
         o = self.optimizer
         if _zero_enabled(o.zero):
+            if self.ddp_bucket_bytes == "auto":
+                # the roofline resolution needs a model + mesh to price;
+                # GPTHybridTrainer owns it (and stores the resolved int
+                # back into its config). A raw build cannot guess a grid
+                # silently — bucket_bytes is a checkpoint-layout property.
+                raise ValueError(
+                    'ddp_bucket_bytes="auto" must be resolved before '
+                    "build_optimizer: construct the trainer "
+                    "(GPTHybridTrainer resolves it via "
+                    "apex_tpu.pyprof.tune_bucket_bytes) or call "
+                    "tune_bucket_bytes yourself and pass the int")
             if o.name in ("adam", "adamw"):
                 return opt.DistributedFusedAdam(
                     lr=o.lr, betas=o.betas, eps=o.eps,
@@ -237,7 +319,11 @@ class TrainConfig:
                     lr=o.lr, betas=o.betas, eps=o.eps,
                     weight_decay=o.weight_decay,
                     bucket_bytes=self.ddp_bucket_bytes)
-            raise ValueError(f"no ZeRO variant of {o.name!r}")
+            # dispatch above covers exactly ZERO_CAPABLE_OPTIMIZERS —
+            # extend both together (fastpath() gates on the same tuple)
+            raise ValueError(
+                f"no ZeRO variant of {o.name!r} (capable: "
+                f"{'|'.join(ZERO_CAPABLE_OPTIMIZERS)})")
         if o.name in ("adam", "adamw"):
             inner = opt.FusedAdam(lr=o.lr, betas=o.betas, eps=o.eps,
                                   adam_w_mode=o.name == "adamw",

@@ -10,18 +10,40 @@ vector and slice it back. These helpers build the static layout
 (shapes/dtypes/offsets, padded to a multiple of ``chunks``) shared by
 :class:`~apex_tpu.optimizers.FlatOptimizer` (single-device tier) and the
 ZeRO optimizers (sharded tier).
+
+Two performance properties live here:
+
+- **layout memoization** — the layout is a pure function of the tree
+  *structure* (treedef, shapes, dtypes, chunks), so :func:`build_layout`
+  memoizes it (and :func:`segment_ids` memoizes its O(padded) host
+  array). Callers that rebuild "per call" — the optimizers' defensive
+  ``_layout_for``, every eager step, every retrace — hit the cache
+  instead of recomputing cumsum/offset tables and re-materializing
+  multi-hundred-MB segment maps; the traced program is byte-identical
+  either way (regression-tested on the jaxpr).
+- **span-local ravel/unravel** — :func:`ravel_span` builds one bucket's
+  slice of the flat vector from ONLY the leaves overlapping that span,
+  and :func:`unravel_parts` rebuilds each leaf from ONLY the bucket
+  pieces covering it. A bucketed grad sync assembled this way carries no
+  data dependency on the whole tree: bucket k's collective can be issued
+  as soon as the backward has produced the leaves in span k (the
+  full-tree ``concatenate`` of :func:`ravel` was a barrier every bucket
+  waited on), and parameter leaf j becomes ready as soon as its own
+  buckets' gathers land. Values are element-identical to
+  ``ravel``/``unravel`` over the same spans.
 """
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Tuple
+from typing import Any, List, NamedTuple, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["FlatLayout", "build_layout", "ravel", "unravel", "segment_ids",
-           "bucket_bounds"]
+           "bucket_bounds", "ravel_span", "unravel_parts",
+           "layout_cache_stats", "clear_layout_cache"]
 
 
 class FlatLayout(NamedTuple):
@@ -35,18 +57,65 @@ class FlatLayout(NamedTuple):
     chunk: int            # padded // chunks
 
 
+# (treedef, shapes, dtypes, chunks) -> FlatLayout. The key is the full
+# static identity of a layout, so a hit returns the IDENTICAL object the
+# first build produced — optimizer `_layout_for` guards that compare
+# layouts across steps see one object, and eager/retraced steps skip the
+# cumsum/offset rebuild. Bounded FIFO: a process cycling through many
+# distinct models cannot leak layouts.
+_LAYOUT_CACHE: dict = {}
+_LAYOUT_CACHE_MAX = 64
+_LAYOUT_STATS = {"hits": 0, "misses": 0}
+# the segment maps are O(padded) int32 HOST arrays (GBs at 1B params),
+# so their cache is bounded by BYTES, not entries — retention of a dead
+# model's multi-GB map is capped at the budget, while the small maps
+# tests and medium models produce still amortize fully
+_SEGMENT_CACHE: dict = {}
+_SEGMENT_CACHE_MAX_BYTES = 256 << 20
+
+
+def layout_cache_stats() -> dict:
+    """``{"hits": n, "misses": n}`` of the :func:`build_layout` memo —
+    the regression surface for the cached-path tests."""
+    return dict(_LAYOUT_STATS)
+
+
+def clear_layout_cache() -> None:
+    _LAYOUT_CACHE.clear()
+    _SEGMENT_CACHE.clear()
+    _LAYOUT_STATS["hits"] = _LAYOUT_STATS["misses"] = 0
+
+
 def build_layout(params: Any, chunks: int = 1) -> FlatLayout:
     """Static layout for ``params``; ``chunks`` is the shard count the
-    padded length must divide into (dp for ZeRO, 1 for single device)."""
+    padded length must divide into (dp for ZeRO, 1 for single device).
+    Memoized on the tree's static identity (treedef/shapes/dtypes/chunks):
+    repeated calls — every step of an eager loop, every defensive
+    ``_layout_for`` re-derivation — return the same object instead of
+    rebuilding the offset tables per call."""
     leaves, treedef = jax.tree_util.tree_flatten(params)
     shapes = tuple(tuple(np.shape(l)) for l in leaves)
     dtypes = tuple(jnp.asarray(l).dtype for l in leaves)
+    key = (treedef, shapes, dtypes, int(chunks))
+    try:
+        cached = _LAYOUT_CACHE.get(key)
+    except TypeError:       # unhashable treedef (exotic custom nodes)
+        cached, key = None, None
+    if cached is not None:
+        _LAYOUT_STATS["hits"] += 1
+        return cached
+    _LAYOUT_STATS["misses"] += 1
     sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
     offsets = tuple(int(x) for x in np.cumsum((0,) + sizes[:-1]))
     total = int(sum(sizes))
     padded = ((total + chunks - 1) // chunks) * chunks
-    return FlatLayout(treedef, shapes, dtypes, sizes, offsets, total,
-                      padded, padded // chunks)
+    lay = FlatLayout(treedef, shapes, dtypes, sizes, offsets, total,
+                     padded, padded // chunks)
+    if key is not None:
+        if len(_LAYOUT_CACHE) >= _LAYOUT_CACHE_MAX:
+            _LAYOUT_CACHE.pop(next(iter(_LAYOUT_CACHE)))
+        _LAYOUT_CACHE[key] = lay
+    return lay
 
 
 def ravel(tree: Any, lay: FlatLayout) -> jnp.ndarray:
@@ -67,6 +136,76 @@ def unravel(flat: jnp.ndarray, lay: FlatLayout) -> Any:
                                        lay.sizes, lay.offsets):
         leaves.append(jax.lax.dynamic_slice_in_dim(flat, off, size)
                       .reshape(shape).astype(dtype))
+    return jax.tree_util.tree_unflatten(lay.treedef, leaves)
+
+
+def ravel_span(tree: Any, lay: FlatLayout, off: int, size: int
+               ) -> jnp.ndarray:
+    """``ravel(tree, lay)[off:off+size]`` built from ONLY the leaves
+    overlapping ``[off, off+size)`` — element-identical to slicing the
+    full flat vector, but without the full-tree ``concatenate`` barrier:
+    a bucket's collective assembled from this depends only on the grads
+    in its own span, so XLA's scheduler can issue it as soon as the
+    backward tail has produced those leaves (the backward-interleave the
+    per-bucket ZeRO chains ride on)."""
+    end = off + size
+    if off < 0 or size <= 0 or end > lay.padded:
+        raise ValueError(f"span [{off}, {end}) outside padded length "
+                         f"{lay.padded} (or empty)")
+    leaves = lay.treedef.flatten_up_to(tree)
+    parts: List[jnp.ndarray] = []
+    for leaf, loff, lsize in zip(leaves, lay.offsets, lay.sizes):
+        lo, hi = max(off, loff), min(end, loff + lsize)
+        if lo >= hi:
+            continue
+        flat_leaf = jnp.reshape(jnp.asarray(leaf), (-1,)).astype(jnp.float32)
+        parts.append(jax.lax.slice_in_dim(flat_leaf, lo - loff, hi - loff))
+    covered = max(0, min(end, lay.total) - min(off, lay.total))
+    if covered < size:           # the padding tail past lay.total
+        parts.append(jnp.zeros(size - covered, jnp.float32))
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+
+def unravel_parts(parts: Sequence[jnp.ndarray],
+                  bounds: Sequence[Tuple[int, int]],
+                  lay: FlatLayout) -> Any:
+    """Rebuild the tree from per-span flat pieces (``parts[i]`` covers
+    ``bounds[i]``, which must tile the padded vector in order) — the
+    inverse of per-bucket :func:`ravel_span`, element-identical to
+    ``unravel(concatenate(parts), lay)`` but with each leaf assembled
+    from ONLY the pieces covering it: parameter leaf j's value depends
+    on its own buckets' producers (the per-bucket all-gathers), not on
+    every bucket's, so the first layers' params are ready while later
+    buckets are still in flight."""
+    if len(parts) != len(bounds):
+        raise ValueError(f"{len(parts)} parts vs {len(bounds)} bounds")
+    off = 0
+    for boff, bsize in bounds:
+        if boff != off or bsize <= 0:
+            raise ValueError(
+                f"bounds {tuple(bounds)} do not tile the flat vector "
+                f"(expected contiguous spans from 0 to {lay.padded})")
+        off += bsize
+    if off != lay.padded:
+        raise ValueError(
+            f"bounds cover [0, {off}) but the layout is padded to "
+            f"{lay.padded} — every leaf must be covered")
+    leaves = []
+    for shape, dtype, lsize, loff in zip(lay.shapes, lay.dtypes,
+                                         lay.sizes, lay.offsets):
+        lend = loff + lsize
+        if lsize == 0:      # zero-size leaf: occupies no span anywhere
+            leaves.append(jnp.zeros(shape, dtype))
+            continue
+        pieces = []
+        for (boff, bsize), part in zip(bounds, parts):
+            lo, hi = max(loff, boff), min(lend, boff + bsize)
+            if lo >= hi:
+                continue
+            pieces.append(jax.lax.slice_in_dim(part, lo - boff, hi - boff))
+        flat_leaf = pieces[0] if len(pieces) == 1 else \
+            jnp.concatenate(pieces)
+        leaves.append(flat_leaf.reshape(shape).astype(dtype))
     return jax.tree_util.tree_unflatten(lay.treedef, leaves)
 
 
@@ -101,8 +240,27 @@ def bucket_bounds(lay: FlatLayout,
 
 def segment_ids(lay: FlatLayout) -> jnp.ndarray:
     """Static flat-index -> tensor-index map (padding gets an extra id so it
-    never contaminates a real tensor's norm)."""
-    ids = np.full(lay.padded, len(lay.sizes), np.int32)
-    for i, (off, size) in enumerate(zip(lay.offsets, lay.sizes)):
-        ids[off:off + size] = i
+    never contaminates a real tensor's norm). The O(padded) host build is
+    memoized per layout (LAMB's step rebuilt it every call); only the
+    HOST array is cached — the ``jnp.asarray`` runs per call, because a
+    device value created inside one trace (a shard_map rewrite tracer)
+    must never leak into another."""
+    key = None
+    try:
+        ids = _SEGMENT_CACHE.get(lay)
+        key = lay
+    except TypeError:
+        ids = None
+    if ids is None:
+        ids = np.full(lay.padded, len(lay.sizes), np.int32)
+        for i, (off, size) in enumerate(zip(lay.offsets, lay.sizes)):
+            ids[off:off + size] = i
+        ids.setflags(write=False)
+        if key is not None and ids.nbytes <= _SEGMENT_CACHE_MAX_BYTES:
+            total = sum(v.nbytes for v in _SEGMENT_CACHE.values())
+            while _SEGMENT_CACHE and \
+                    total + ids.nbytes > _SEGMENT_CACHE_MAX_BYTES:
+                total -= _SEGMENT_CACHE.pop(
+                    next(iter(_SEGMENT_CACHE))).nbytes
+            _SEGMENT_CACHE[key] = ids
     return jnp.asarray(ids)

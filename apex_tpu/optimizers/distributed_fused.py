@@ -63,8 +63,9 @@ import jax.numpy as jnp
 from apex_tpu.observability import ingraph as _metrics
 from apex_tpu.optimizers._base import OptimizerBase, bias_correction
 from apex_tpu.optimizers._flatten import (FlatLayout, bucket_bounds,
-                                          build_layout, ravel, segment_ids,
-                                          unravel)
+                                          build_layout, ravel,
+                                          ravel_span, segment_ids,
+                                          unravel_parts)
 from apex_tpu.utils.compat import axis_size as _axis_size
 
 __all__ = ["DistributedFusedAdam", "DistributedFusedLAMB",
@@ -182,9 +183,13 @@ class _DistributedFusedBase(OptimizerBase):
     def _shard_grad_parts(self, grads: Any, lay: FlatLayout) -> list:
         """Per-bucket reduce_scatter: flat-averaged grads, this rank's slice
         of each bucket — B independent collectives the scheduler can overlap
-        with the per-bucket update math downstream."""
+        with the per-bucket update math downstream. Each bucket is raveled
+        span-locally (``_flatten.ravel_span``): its reduce-scatter consumes
+        only the grad leaves in its span, so the scheduler can issue it
+        under the tail of the backward (and under the accumulation window)
+        as soon as those leaves exist, instead of waiting on a full-tree
+        concatenate of every gradient."""
         from apex_tpu.parallel.distributed import reduce_scatter_grads
-        flat_g = ravel(grads, lay)
         bounds = self._bounds(lay)
         if _metrics.recording():
             _metrics.record("ddp/reduce_scatter_bytes",
@@ -203,7 +208,7 @@ class _DistributedFusedBase(OptimizerBase):
         inv_dp = 1.0 / self._dp(lay)
         return [
             reduce_scatter_grads(
-                jax.lax.slice_in_dim(flat_g, off, off + n),
+                ravel_span(grads, lay, off, n),
                 self.axis_name) * inv_dp
             for off, n in bounds]
 
@@ -211,19 +216,22 @@ class _DistributedFusedBase(OptimizerBase):
         """reduce_scatter: flat-averaged grads, this rank's shard only."""
         return _cat(self._shard_grad_parts(grads, lay))
 
-    def _gather_master_parts(self, parts: list, lay: FlatLayout
-                             ) -> jnp.ndarray:
-        """Per-bucket all-gather of updated master slices back to the full
-        flat vector. Each bucket's gather depends only on that bucket's
-        update, so it can start while later buckets are still in their
-        math."""
-        gathered = [_all_gather_flat(p, self.axis_name, axis=0)
-                    for p in parts]
-        return _cat(gathered)
+    def _gather_master_parts(self, parts: list, lay: FlatLayout) -> list:
+        """Per-bucket all-gather of updated master slices back to
+        per-bucket full spans. Each bucket's gather depends only on that
+        bucket's update, so it can start while later buckets are still in
+        their math — and downstream, each parameter leaf is unraveled
+        from only its own buckets (:meth:`_unravel_parts_like`), so the
+        full flat vector is never concatenated back together."""
+        return [_all_gather_flat(p, self.axis_name, axis=0) for p in parts]
 
-    def _unravel_like(self, flat: jnp.ndarray, lay: FlatLayout,
-                      like: Any = None) -> Any:
-        new_params = unravel(flat, lay)
+    def _unravel_parts_like(self, parts: list, lay: FlatLayout,
+                            like: Any = None) -> Any:
+        """Per-bucket inverse of ravel: ``parts[i]`` covers the i-th
+        bucket span; each leaf is assembled from only the parts covering
+        it — parameter leaf j is ready as soon as its own buckets'
+        gathers land, not after every bucket's."""
+        new_params = unravel_parts(parts, self._bounds(lay), lay)
         if like is None:
             return new_params
         # the flat master mixes leaves with different varying-axes sets, so
@@ -248,8 +256,8 @@ class _DistributedFusedBase(OptimizerBase):
         """all_gather of a whole updated master shard (per-bucket under the
         hood) and unravel back to the parameter pytree."""
         parts = [master[o:o + n] for o, n in self._shard_bounds(lay)]
-        return self._unravel_like(self._gather_master_parts(parts, lay),
-                                  lay, like)
+        return self._unravel_parts_like(
+            self._gather_master_parts(parts, lay), lay, like)
 
 
 class DistributedFusedAdam(_DistributedFusedBase):
@@ -301,10 +309,14 @@ class DistributedFusedAdam(_DistributedFusedBase):
 
         # Per-bucket pipeline: bucket b's chain is
         #   reduce_scatter(b) -> moment/update math(b) -> all_gather(b)
-        # with no cross-bucket dependencies, so XLA's latency-hiding
-        # scheduler can run bucket k's gather transfer under bucket k+1's
-        # math (and the scatters under the backward tail). Unbucketed this
-        # degenerates to the original single-chain program.
+        # with no cross-bucket dependencies AND no full-tree joins on
+        # either end (span-local ravel in, per-bucket unravel out), so
+        # XLA's latency-hiding scheduler can issue bucket k's scatter
+        # under the backward tail the moment its grads exist, run bucket
+        # k's gather transfer under bucket k+1's scatter + math, and hand
+        # each layer its updated params as soon as that layer's buckets
+        # land. Unbucketed this degenerates to the original single-chain
+        # program.
         g_parts = self._shard_grad_parts(grads, lay)
         sbounds = self._shard_bounds(lay)
         ms, vs, masters, gathered = [], [], [], []
@@ -323,7 +335,7 @@ class DistributedFusedAdam(_DistributedFusedBase):
             masters.append(new_master)
             gathered.append(_all_gather_flat(new_master, self.axis_name,
                                              axis=0))
-        new_params = self._unravel_like(_cat(gathered), lay, like=params)
+        new_params = self._unravel_parts_like(gathered, lay, like=params)
         return new_params, ZeroAdamState(
             step=t, master=_cat(masters), exp_avg=_cat(ms),
             exp_avg_sq=_cat(vs), bucket_stamp=state.bucket_stamp)

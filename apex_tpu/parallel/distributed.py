@@ -145,14 +145,21 @@ def _group_size_for_rank(axis_name: str, groups) -> jnp.ndarray:
 def _bucketed_allreduce(grads: Any, axis_name: str,
                         gradient_predivide_factor: float,
                         gradient_average: bool, bucket_bytes: int) -> Any:
-    """The bucketing engine: ravel the grad tree into one flat fp32 vector,
-    psum it in B fixed-size buckets (independent collectives XLA can
-    overlap), scale per bucket, unravel. Always reduces in fp32 — the
-    ravel *is* the fp32 master-grad copy, so ``allreduce_always_fp32``
-    is implied on this path (same numeric contract as the ZeRO
-    reduce-scatter)."""
+    """The bucketing engine: ravel the grad tree into B fixed-size flat
+    fp32 buckets and psum each (independent collectives XLA can overlap),
+    scale per bucket, unravel. Always reduces in fp32 — the ravel *is*
+    the fp32 master-grad copy, so ``allreduce_always_fp32`` is implied on
+    this path (same numeric contract as the ZeRO reduce-scatter).
+
+    Span-local assembly (``_flatten.ravel_span``/``unravel_parts``): each
+    bucket's psum consumes only the grad leaves in its span — not a
+    full-tree concatenate — so the scheduler can issue bucket k's
+    transfer while the backward is still producing later buckets' grads,
+    and each synced leaf is rebuilt from only the buckets covering it.
+    The full padded flat vector never materializes (asserted on the
+    jaxpr in tests)."""
     from apex_tpu.optimizers._flatten import (bucket_bounds, build_layout,
-                                              ravel, unravel)
+                                              ravel_span, unravel_parts)
     lay = build_layout(grads, chunks=1)
     bounds = bucket_bounds(lay, bucket_bytes)
     world = _axis_size(axis_name)
@@ -171,21 +178,21 @@ def _bucketed_allreduce(grads: Any, axis_name: str,
         post = pre if pre != 1.0 else None
 
     with jax.named_scope("apex_ddp_bucketed_allreduce"):
-        flat = ravel(grads, lay)
-        if pre != 1.0:
-            flat = flat / pre
         pieces = []
         for off, n in bounds:
-            # one psum per bucket; the post-scale is per-bucket epilogue
-            # work the scheduler can run under the next bucket's transfer
+            # one psum per bucket, assembled span-locally: this bucket's
+            # transfer depends only on the grads in its span, and the
+            # pre/post scales are per-bucket epilogue work the scheduler
+            # can run under the next bucket's transfer
+            b = ravel_span(grads, lay, off, n)
+            if pre != 1.0:
+                b = b / pre
             b = jax.lax.psum(
-                cast_to_vma(jax.lax.slice_in_dim(flat, off, off + n),
-                            frozenset({axis_name})), axis_name)
+                cast_to_vma(b, frozenset({axis_name})), axis_name)
             if post is not None:
                 b = b * post
             pieces.append(b)
-        flat = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
-    synced = unravel(flat, lay)
+    synced = unravel_parts(pieces, bounds, lay)
     _health.observe_replica_agreement(synced, axis_name, name="ddp_grads")
     return synced
 

@@ -42,11 +42,14 @@ from apex_tpu.pyprof.model import (  # noqa: F401
 from apex_tpu.pyprof.attribute import (  # noqa: F401
     AttributionReport, RegionAttribution, attribute,
     region_times_from_spans, region_times_from_trace_dir)
+from apex_tpu.pyprof.tune import (  # noqa: F401
+    bucket_wire_ms, tune_bucket_bytes)
 
 __all__ = ["annotate", "attribute", "model_program", "jaxpr_of",
            "AttributionReport", "RegionAttribution", "ProgramCost",
            "RegionCost", "DEFAULT_REGIONS", "UNATTRIBUTED",
-           "region_times_from_spans", "region_times_from_trace_dir"]
+           "region_times_from_spans", "region_times_from_trace_dir",
+           "tune_bucket_bytes", "bucket_wire_ms"]
 
 # NVTX-era surface -> migration pointers (annotate -> trace -> attribute)
 _DEPRECATED = {

@@ -1,0 +1,157 @@
+"""Roofline-driven DP bucket autotuning (``ddp_bucket_bytes="auto"``).
+
+The bucketed gradient-sync engine (:mod:`apex_tpu.parallel.distributed`)
+trades two quantities against each other: *smaller* buckets mean more
+independent collectives in flight — more overlap opportunity under the
+backward — but each collective pays a fixed launch/rendezvous latency;
+*larger* buckets amortize the latency but serialize more wire time behind
+fewer dependency edges, and the tail bucket's transfer has nothing left
+to hide under. The right size is the smallest bucket whose wire time is
+fully hideable under the compute that runs concurrently with it — a
+quantity the :mod:`~apex_tpu.pyprof.model` roofline already prices on
+both sides:
+
+- **wire side** — :func:`bucket_wire_ms`: the ring model's per-bucket
+  traffic (reduce-scatter ``(n-1)/n`` + all-gather ``(n-1)/n`` of the
+  bucket = ``2(n-1)/n`` — the ZeRO chain; the bucketed allreduce moves
+  the same ``2(n-1)/n``) over the chip's per-link ICI bandwidth, plus a
+  per-collective launch latency floor (the term that makes tiny buckets
+  lose);
+- **compute side** — the program's modeled non-comm time
+  (``max(compute_ms, hbm_ms)`` per region, the roofline's "this work
+  occupies the chip regardless of traffic"), which a step spreads
+  uniformly over its B buckets: bucket k's transfer hides under the
+  ~1/B of backward compute that runs while it is in flight.
+
+:func:`tune_bucket_bytes` evaluates a candidate ladder (powers of two)
+and picks the **smallest fully-hideable** candidate; when no candidate is
+fully hideable (wire-starved programs) it picks the candidate with the
+least total exposed wire time — deterministically, so the choice is
+stable across restarts (the resolved size is a ZeRO *layout* property:
+``bucket_stamp`` persists it into checkpoints). Programs the model
+cannot price (no compute to hide under, a walk failure) fall back LOUDLY
+(``warnings.warn``) to
+:data:`~apex_tpu.parallel.distributed.DEFAULT_BUCKET_BYTES`.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Optional, Sequence, Tuple
+
+from apex_tpu.observability.costs import DeviceSpec, device_spec
+from apex_tpu.pyprof.model import DEFAULT_REGIONS, model_program
+
+__all__ = ["tune_bucket_bytes", "bucket_wire_ms", "DEFAULT_CANDIDATES",
+           "DEFAULT_COLLECTIVE_LATENCY_US"]
+
+# candidate ladder: 256 KiB .. 64 MiB powers of two. The floor keeps the
+# per-collective latency term from dominating; the ceiling is past the
+# point where a bucket's transfer can hide under any realistic backward
+# slice (torch-DDP's default is 25 MB — inside this ladder).
+DEFAULT_CANDIDATES: Tuple[int, ...] = tuple(
+    1 << s for s in range(18, 27))  # 256KiB, 512KiB, ..., 64MiB
+
+# per-collective launch/rendezvous latency floor (one-way, per
+# collective). ICI collective setup is single-digit microseconds; the
+# value only needs the right order of magnitude — it is the term that
+# rules out pathologically small buckets, not a precision input.
+DEFAULT_COLLECTIVE_LATENCY_US = 5.0
+
+
+def bucket_wire_ms(bucket_bytes: float, axis_size: int,
+                   spec: Optional[DeviceSpec] = None, *,
+                   latency_us: float = DEFAULT_COLLECTIVE_LATENCY_US
+                   ) -> float:
+    """Modeled wire milliseconds of ONE bucket's sync chain over an
+    ``axis_size``-rank ring: reduce-scatter + all-gather (the ZeRO
+    RS→math→AG chain; the bucketed allreduce's ``2(n-1)/n`` ring psum
+    prices identically) plus two collective-launch latencies. Strictly
+    monotone in ``bucket_bytes`` and in ``axis_size``; zero at
+    ``axis_size == 1`` (no wire, no launch)."""
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes must be positive, got {bucket_bytes}")
+    n = int(axis_size)
+    if n <= 1:
+        return 0.0
+    if spec is None:
+        spec = device_spec()
+    frac = 2.0 * (n - 1) / n          # RS (n-1)/n + AG (n-1)/n
+    return spec.comm_ms(frac * float(bucket_bytes)) \
+        + 2.0 * latency_us / 1e3
+
+
+def _fallback(reason: str) -> int:
+    from apex_tpu.parallel.distributed import DEFAULT_BUCKET_BYTES
+    warnings.warn(
+        f"tune_bucket_bytes: {reason}; falling back to "
+        f"DEFAULT_BUCKET_BYTES={DEFAULT_BUCKET_BYTES} "
+        f"({DEFAULT_BUCKET_BYTES >> 20} MiB)", stacklevel=3)
+    return DEFAULT_BUCKET_BYTES
+
+
+def tune_bucket_bytes(program=None, *, grad_bytes: float, axis_size: int,
+                      spec: Optional[DeviceSpec] = None,
+                      hide_ms: Optional[float] = None,
+                      passes: int = 1,
+                      args: Optional[tuple] = None,
+                      regions: Sequence[str] = DEFAULT_REGIONS,
+                      candidates: Sequence[int] = DEFAULT_CANDIDATES,
+                      latency_us: float = DEFAULT_COLLECTIVE_LATENCY_US
+                      ) -> int:
+    """Resolve ``ddp_bucket_bytes="auto"``: the smallest candidate bucket
+    whose RS+AG wire time is fully hideable under the program's modeled
+    compute.
+
+    ``program`` is anything :func:`~apex_tpu.pyprof.model.jaxpr_of`
+    accepts (typically the traced per-microbatch fwd+bwd); its modeled
+    non-comm time — ``sum(max(compute_ms, hbm_ms))`` over regions, times
+    ``passes`` (microbatches per step: the sync fires once per window, so
+    every pass's backward is hiding room) — is the hide window.
+    ``hide_ms`` supplies that window directly and skips the pricing (the
+    testable core). ``grad_bytes`` is the flat fp32 gradient size the
+    sync moves (4 x param count); ``axis_size`` the DP ring.
+
+    Decision rule, deterministic by construction: candidate c carves the
+    gradient into ``B = ceil(grad_bytes / c)`` buckets, each allotted
+    ``hide_ms / B`` of concurrent compute; c is *fully hideable* when
+    :func:`bucket_wire_ms`\\(c) fits its allotment. The smallest hideable
+    candidate wins (most overlap edges at no exposed wire); if none is
+    hideable, the candidate with the least total exposed wire
+    ``B x (wire - allotment)`` wins (ties to the smaller size). Returns
+    a plain ``int``. Unpriceable inputs — no program and no ``hide_ms``,
+    a model walk failure, a non-positive window or ``grad_bytes`` — fall
+    back loudly to ``DEFAULT_BUCKET_BYTES`` via ``warnings.warn``.
+    """
+    if grad_bytes is None or grad_bytes <= 0:
+        return _fallback(f"non-positive grad_bytes ({grad_bytes})")
+    if hide_ms is None:
+        if program is None:
+            return _fallback("no program and no hide_ms to price against")
+        try:
+            cost = model_program(program, args, spec=spec, regions=regions)
+        except Exception as e:
+            return _fallback(f"program could not be priced ({e!r})")
+        spec = cost.spec
+        hide_ms = sum(max(r.compute_ms, r.hbm_ms)
+                      for r in cost.regions.values()) * max(1, passes)
+    if spec is None:
+        spec = device_spec()
+    if hide_ms <= 0.0:
+        return _fallback(f"modeled hide window is {hide_ms} ms — nothing "
+                         "to hide transfers under")
+    ladder = sorted(int(c) for c in candidates)
+    if not ladder or ladder[0] <= 0:
+        raise ValueError(f"invalid candidate ladder {candidates!r}")
+    best, best_exposed = None, None
+    for c in ladder:
+        n_buckets = max(1, -(-int(grad_bytes) // c))  # ceil div
+        wire = bucket_wire_ms(min(c, grad_bytes), axis_size, spec,
+                              latency_us=latency_us)
+        allot = hide_ms / n_buckets
+        if wire <= allot:
+            return c                   # smallest fully-hideable candidate
+        exposed = n_buckets * (wire - allot)
+        if best_exposed is None or exposed < best_exposed:
+            best, best_exposed = c, exposed
+    return best

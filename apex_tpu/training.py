@@ -9,10 +9,12 @@ so the spec plumbing lives in exactly one place.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.config import TrainConfig
@@ -29,7 +31,78 @@ from apex_tpu.utils.compat import (HAS_VMA, shard_map_unchecked,
                                    axis_size as _compat_axis_size)
 from apex_tpu.utils.vma import cast_to_vma, scan_stable_vma
 
-__all__ = ["GPTHybridTrainer", "accumulate_gradients"]
+__all__ = ["GPTHybridTrainer", "accumulate_gradients",
+           "resolve_bucket_bytes"]
+
+
+def resolve_bucket_bytes(cfg: TrainConfig, model, mesh) -> int:
+    """Resolve ``ddp_bucket_bytes="auto"`` for one trainer: price the
+    model's per-microbatch fwd+bwd with the pyprof roofline and hand
+    :func:`apex_tpu.pyprof.tune_bucket_bytes` the resulting hide window
+    (smallest bucket whose RS+AG wire time is fully hideable — see
+    pyprof/tune.py for the decision rule).
+
+    Pricing convention: a single-chip twin of the model (tp=1, SP off —
+    the sharded program would need a bound mesh just to trace) is traced
+    abstractly at the config's ``(micro_batch, seq)`` shape, its modeled
+    non-comm time divided by ``tp*pp`` (each chip computes ~1/(tp*pp) of
+    the model) and multiplied by the M microbatches whose backwards all
+    run inside one sync window. Estimates feed a bucket-size *choice*,
+    not a perf claim — candidates are powers of two, so only the order
+    of magnitude matters. Deterministic for a given config + device
+    spec (the resolved grid is a checkpoint-layout property: the ZeRO
+    ``bucket_stamp`` persists it). Unpriceable models fall back loudly
+    to ``DEFAULT_BUCKET_BYTES`` inside ``tune_bucket_bytes``."""
+    from apex_tpu.observability.registry import get_registry
+    from apex_tpu.pyprof import tune_bucket_bytes
+    from apex_tpu.pyprof.model import model_program
+
+    mesh_shape = dict(mesh.shape)
+    dp = int(mesh_shape.get("data", 1))
+    tp = int(mesh_shape.get("tensor", 1))
+    pp = int(mesh_shape.get("pipe", 1))
+    mb = cfg.batch.micro_batch_size
+    num_micro = max(1, cfg.batch.global_batch_size // max(1, mb * dp))
+    try:
+        twin = type(model)(dataclasses.replace(
+            model.cfg, tensor_model_parallel_size=1,
+            sequence_parallel=False, tp_comm_overlap=False))
+        pshapes = jax.eval_shape(twin.init, jax.random.PRNGKey(0))
+        # per-chip on BOTH sides of the decision rule: each chip syncs
+        # its own 1/(tp*pp) parameter shard over the dp ring, and hides
+        # it under its own 1/(tp*pp) slice of the model's compute
+        grad_bytes = 4.0 * sum(
+            int(np.prod(l.shape)) if l.shape else 1
+            for l in jax.tree_util.tree_leaves(pshapes)) / (tp * pp)
+        seq = model.cfg.max_position_embeddings
+        tokens = jax.ShapeDtypeStruct((mb, seq), jnp.int32)
+
+        def fwd_bwd(params, tokens):
+            return jax.grad(lambda p: twin.loss(p, tokens, tokens))(params)
+
+        traced = jax.jit(fwd_bwd).trace(pshapes, tokens)
+        cost = model_program(traced)
+        hide_ms = sum(max(r.compute_ms, r.hbm_ms)
+                      for r in cost.regions.values()) \
+            * num_micro / (tp * pp)
+        spec = cost.spec
+    except Exception as e:
+        # loud with the REAL reason — a swallowed pricing error would
+        # leave every "auto" run on the default grid with a warning
+        # blaming missing inputs instead of the actual failure
+        import warnings
+
+        from apex_tpu.parallel.distributed import DEFAULT_BUCKET_BYTES
+        warnings.warn(
+            f'ddp_bucket_bytes="auto": roofline pricing of the model '
+            f"failed ({e!r}); falling back to DEFAULT_BUCKET_BYTES="
+            f"{DEFAULT_BUCKET_BYTES}", stacklevel=2)
+        resolved = DEFAULT_BUCKET_BYTES
+    else:
+        resolved = tune_bucket_bytes(grad_bytes=grad_bytes, axis_size=dp,
+                                     hide_ms=hide_ms, spec=spec)
+    get_registry().gauge("ddp/auto_bucket_bytes").set(float(resolved))
+    return int(resolved)
 
 
 def accumulate_gradients(ddp, loss_fn, params, microbatches):
@@ -124,14 +197,27 @@ class GPTHybridTrainer:
         in the step's Metrics pytree; the uninstrumented
         :meth:`train_step` and the ``level="off"`` program stay
         jaxpr-identical to an unconfigured trainer (asserted in tests)."""
-        self.cfg = cfg
         self.mesh = mesh
         self.health = health if health is not None else cfg.build_health()
-        # DP-sync bucketing (None = per-leaf psums / monolithic ZeRO
-        # collectives, provably identical to the pre-bucketing trainer)
-        self.bucket_bytes = cfg.ddp_bucket_bytes
         self.pp = cfg.parallel.pipeline_model_parallel_size
         self.model = cfg.build_model()
+        # DP-sync bucketing (None = per-leaf psums / monolithic ZeRO
+        # collectives, provably identical to the pre-bucketing trainer).
+        # "auto" resolves HERE, against this model/mesh via the pyprof
+        # roofline, and the resolved int is stored back into the config —
+        # to_dict()/checkpoint sidecars carry the concrete grid, and the
+        # ZeRO bucket_stamp guard keys on the same value.
+        bb = cfg.ddp_bucket_bytes
+        if bb == "auto":
+            cfg = dataclasses.replace(
+                cfg, ddp_bucket_bytes=resolve_bucket_bytes(
+                    cfg, self.model, mesh))
+        elif not (bb is None or isinstance(bb, int)):
+            raise ValueError(
+                f'ddp_bucket_bytes must be None, an int, or "auto"; '
+                f"got {bb!r}")
+        self.cfg = cfg
+        self.bucket_bytes = cfg.ddp_bucket_bytes
         # Activation-remat policy (apex_tpu/remat.py), resolved by the
         # model from ModelConfig.remat_policy / the deprecated remat bool.
         # The pipelined stage_fn is wrapped inside the model, so the
@@ -352,7 +438,16 @@ class GPTHybridTrainer:
                     grad_scale=ls.loss_scale)
             grads = (jax.tree_util.tree_map(lambda g: g[None], sg), shg)
             # (ZeRO: the optimizer's psum_scatter/dp IS the DDP mean —
-            # reduce_scatter replaces the allreduce, the ZeRO comm win)
+            # reduce_scatter replaces the allreduce, the ZeRO comm win.
+            # With bucket_bytes set the apply is backward-interleaved:
+            # each bucket's RS ravels span-locally from only its own
+            # grad leaves, so the scheduler issues it under the tail of
+            # the backward/accumulation window, and each param leaf
+            # unravels from only its own buckets' gathers — bucket k's
+            # AG rides under bucket k+1's RS + shard math. The finite
+            # check below therefore consumes the LOCAL grads, never the
+            # bucket collectives: the scale/skip select is one tiny
+            # flag the transfers can run under.)
             if self.is_zero:
                 # grads are still per-data-rank here, so the skip decision
                 # must sync over data too (the reference's distributed

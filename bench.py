@@ -55,7 +55,16 @@ Other configs:
              collective matmuls vs the fused all_gather/psum_scatter
              baseline (``gpt_sp_overlap_tokens_per_sec``; needs >= 2
              devices, emits a skip line otherwise — docs/PERF.md
-             "Dependent-collective overlap").
+             "Dependent-collective overlap");
+  fast     — the compound ``fastpath`` preset (tp_comm_overlap +
+             bucketed DP + ZeRO-1 backward-interleaved apply +
+             selective remat + donation) through the hybrid trainer vs
+             the same-mesh baseline config
+             (``gpt_fast_tokens_per_sec``; needs >= 2 devices; CPU
+             ratio ~1.0 documented — docs/PERF.md "Flagship tuning").
+             The trainer-leg configs are the declarative
+             ``BENCH_TRAIN_CONFIGS`` table, statically validated by
+             ``scripts/check_bench_configs.py``.
 """
 
 import json
@@ -102,18 +111,22 @@ def _mem_extra(compiled) -> dict:
 
 
 def _attrib_extra(traced, step_ms) -> dict:
-    """``modeled_step_ms``/``comm_exposed_ms`` extras for a bench line:
-    the pyprof roofline lower bound of the traced step and the modeled
-    communication the measured step failed to hide (0.0 on comm-free
-    single-chip programs; see docs/OBSERVABILITY.md "Step-time
-    attribution"). {} when the model cannot price the program, so lines
-    never carry fabricated numbers."""
+    """``modeled_step_ms``/``comm_exposed_ms``/``overlap_efficiency``
+    extras for a bench line: the pyprof roofline lower bound of the
+    traced step, the modeled communication the measured step failed to
+    hide (0.0 on comm-free single-chip programs), and the fraction of
+    modeled ICI bytes that rode under compute (absent on comm-free
+    programs) — so bench rounds track *exposure*, not just step_ms (see
+    docs/OBSERVABILITY.md "Step-time attribution"). {} when the model
+    cannot price the program, so lines never carry fabricated numbers."""
     try:
         from apex_tpu.pyprof import attribute
         rep = attribute(traced, step_ms / 1e3)
         out = {"modeled_step_ms": round(rep.modeled_step_ms, 3)}
         if rep.comm_exposed_ms is not None:
             out["comm_exposed_ms"] = round(rep.comm_exposed_ms, 3)
+        if rep.overlap_efficiency is not None:
+            out["overlap_efficiency"] = round(rep.overlap_efficiency, 4)
         return out
     except Exception:
         return {}
@@ -519,6 +532,142 @@ def bench_gpt_remat(iters=10, warmup=2, batch=8, seq=1024, hidden=768,
                   peak_hbm_bytes=mem.get("peak_hbm_bytes"))
 
 
+# Declarative trainer-driven bench configs (fmengine-style: the config
+# surface a tuned compound run needs, as data). Keys are REAL
+# TrainConfig/ModelConfig/OptimizerConfig field names — statically
+# validated by scripts/check_bench_configs.py (wired into tier-1), so a
+# renamed flag breaks the check instead of silently dropping a leg back
+# to defaults. "gpt_base" is the headline config-5 shape through the
+# hybrid trainer; "gpt_fast" is the compound overlap preset laid over it
+# — the same knobs TrainConfig.fastpath() applies (asserted equal in
+# tests/test_fastpath.py, so this record cannot drift from the preset;
+# fastpath() additionally turns on sequence_parallel + tp_comm_overlap
+# when the mesh/jax can carry them).
+BENCH_TRAIN_CONFIGS = {
+    "gpt_base": {
+        "model": {"name": "gpt", "vocab_size": 32768, "hidden_size": 768,
+                  "num_layers": 12, "num_attention_heads": 12,
+                  "max_position_embeddings": 1024},
+        "optimizer": {"name": "adam", "lr": 1e-4, "weight_decay": 0.01},
+        "opt_level": "O2",
+        "half_dtype": "bfloat16",
+    },
+    "gpt_fast": {
+        "model": {"remat_policy": "selective"},
+        "optimizer": {"zero": 1},
+        "ddp_bucket_bytes": "auto",
+    },
+}
+
+
+def _train_config_from_spec(*specs, parallel=None, batch=None):
+    """Merge declarative spec dicts (later wins, nested sections update)
+    into a TrainConfig; unknown keys fail in the dataclass constructors
+    (and statically in scripts/check_bench_configs.py)."""
+    from apex_tpu.config import TrainConfig
+
+    merged = {}
+    for spec in specs:
+        for k, v in spec.items():
+            if isinstance(v, dict):
+                merged.setdefault(k, {}).update(v)
+            else:
+                merged[k] = v
+    if parallel is not None:
+        merged["parallel"] = dict(parallel)
+    if batch is not None:
+        merged["batch"] = dict(batch)
+    return TrainConfig.from_dict(merged)
+
+
+def bench_gpt_fast(iters=10, warmup=2, mb=8, seq=1024, max_devices=None):
+    """Compound fastpath A/B: the headline GPT-small shape through the
+    hybrid trainer on the full device set, baseline config vs
+    ``TrainConfig.fastpath()`` — tp_comm_overlap (mesh/jax permitting) +
+    bucketed DP + ZeRO-1 with backward-interleaved per-bucket RS→math→AG
+    + selective remat + donated state, the first time every overlap
+    feature is compounded on the flagship bench. Same session, same
+    mesh, same data; ``vs_baseline`` is fast/base tokens-per-sec (> 1
+    means the compound config pays). ``bucket_bytes`` in the line is the
+    roofline-resolved ``"auto"`` grid. On a CPU host mesh there is no
+    ICI latency to hide, so ~1.0 is the expected and documented reading
+    (docs/PERF.md "Flagship tuning") — the win must be read off a
+    multi-chip run, where ``overlap_efficiency``/``comm_exposed_ms`` on
+    this line say how much of the modeled traffic actually hid. Skipped
+    below 2 devices (the compound config is comm machinery; single-chip
+    deltas are the remat bench's job)."""
+    from apex_tpu.training import GPTHybridTrainer
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.utils.compat import HAS_VMA
+
+    if jax.device_count() < 2:
+        _emit("gpt_fast_tokens_per_sec", -1.0, "skipped", None,
+              error=f"needs >= 2 devices, have {jax.device_count()}")
+        return
+
+    # tp=2 only where the trainer can carry SP overlap (VMA jax) and a
+    # data axis remains; otherwise all devices go to dp. ``max_devices``
+    # caps the mesh (the tier-1 smoke test runs this leg on 2 of the 8
+    # virtual devices — compile cost scales with mesh width on CPU)
+    n_dev = jax.device_count()
+    if max_devices is not None:
+        n_dev = min(n_dev, int(max_devices))
+    tp = 2 if (HAS_VMA and n_dev % 2 == 0 and n_dev >= 4) else 1
+    dp, M = n_dev // tp, 1
+    parallel = {"tensor_model_parallel_size": tp,
+                "pipeline_model_parallel_size": 1}
+    batch = {"global_batch_size": M * mb * dp, "micro_batch_size": mb}
+    base_cfg = _train_config_from_spec(BENCH_TRAIN_CONFIGS["gpt_base"],
+                                       parallel=parallel, batch=batch)
+    fast_cfg = base_cfg.fastpath()
+    vocab = base_cfg.model.vocab_size
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, vocab, (M, dp * mb, seq)))
+    targets = jnp.asarray(rng.randint(0, vocab, (M, dp * mb, seq)))
+
+    def measure(cfg):
+        mesh = cfg.initialize_mesh(devices=jax.devices()[: tp * dp])
+        try:
+            tr = GPTHybridTrainer(cfg, mesh)
+            state = tr.init_state(jax.random.PRNGKey(0))
+            jitted = jax.jit(tr.train_step, donate_argnums=(0, 1, 2))
+            traced, compiled = _trace_and_compile(jitted, *state, tokens,
+                                                  targets)
+
+            def wrapped(s0, s1, s2, ls, tokens, targets):
+                _loss, s0, s1, s2, ls = compiled(s0, s1, s2, ls, tokens,
+                                                 targets)
+                return s0, s1, s2, ls, tokens, targets
+
+            times = _timeit(wrapped, (*state, tokens, targets), iters,
+                            warmup)
+            tps = M * dp * mb * seq / float(np.mean(times))
+            return tps, times, _mem_extra(compiled), traced, tr
+        finally:
+            parallel_state.destroy_model_parallel()
+
+    base_tps, _, _, _, _ = measure(base_cfg)
+    fast_tps, times, mem, traced, tr = measure(fast_cfg)
+    step_ms = float(np.mean(times) * 1e3)
+    _emit("gpt_fast_tokens_per_sec", fast_tps, "tokens/sec",
+          fast_tps / base_tps, base_tps=round(base_tps, 2),
+          step_ms=round(step_ms, 3),
+          std_ms=round(float(np.std(times) * 1e3), 3),
+          tp=tp, dp=dp, batch=mb, seq=seq,
+          # the resolved compound config, real field names only —
+          # scripts/check_bench_configs.py validates these keys against
+          # the dataclasses, so a renamed flag cannot ride along silently
+          config={
+              "model": {
+                  "remat_policy": fast_cfg.model.remat_policy,
+                  "sequence_parallel": fast_cfg.model.sequence_parallel,
+                  "tp_comm_overlap": fast_cfg.model.tp_comm_overlap},
+              "optimizer": {"zero": 1},
+              "ddp_bucket_bytes": tr.bucket_bytes,
+          },
+          **mem, **_attrib_extra(traced, step_ms))
+
+
 def bench_gpt_sp_overlap(iters=10, warmup=2, batch=8, seq=1024,
                          hidden=768, layers=12, heads=12, vocab=32768):
     """Dependent-collective overlap A/B: GPT-small fwd+bwd tokens/sec at
@@ -747,12 +896,14 @@ def main():
         t0 = time.perf_counter()
         # the multi-compile configs run LAST, newest first to be starved:
         # sp_ovl (two GPT TP=2 compiles) after the longer-tracked configs
-        # above it, and remat (FOUR GPT-small train-step compiles, the
-        # heaviest config) dead last so a tight budget drops the newest
-        # metrics, never the established baseline rows
+        # above it, remat (FOUR GPT-small train-step compiles) next, and
+        # gpt_fast (two full hybrid-trainer compiles, the newest leg)
+        # dead last so a tight budget drops the newest metrics, never
+        # the established baseline rows
         for fn in (bench_layernorm, bench_optimizer, bench_gpt,
                    bench_flash_long, bench_dp_accumulate_overlap,
-                   bench_gpt_sp_overlap, bench_gpt_remat):
+                   bench_gpt_sp_overlap, bench_gpt_remat,
+                   bench_gpt_fast):
             if time.perf_counter() - t0 > budget_s:
                 _emit(fn.__name__, -1.0, "skipped", None,
                       error="config budget exhausted; headline protected")

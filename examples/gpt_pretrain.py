@@ -73,6 +73,15 @@ def main(argv=None):
                     help="ring-decomposed SP collectives overlapping "
                          "their GEMMs (implies --sequence-parallel; see "
                          "docs/PERF.md)")
+    ap.add_argument("--fastpath", action="store_true",
+                    help="the compound overlap preset "
+                         "(TrainConfig.fastpath): ZeRO-1 with "
+                         "backward-interleaved per-bucket RS/AG chains, "
+                         "roofline-autotuned DP buckets "
+                         "(--bucket-bytes overrides), selective remat, "
+                         "and — at tp>1, pp==1 on VMA jax — "
+                         "sequence-parallel tp_comm_overlap "
+                         "(docs/PERF.md 'Flagship tuning')")
     args = ap.parse_args(argv)
     if args.tp_comm_overlap:
         args.sequence_parallel = True
@@ -96,6 +105,11 @@ def main(argv=None):
         optimizer=OptimizerConfig(name="adam", lr=1e-3, weight_decay=0.0,
                                   zero=args.zero),
         opt_level="O0", ddp_bucket_bytes=args.bucket_bytes)
+    if args.fastpath:
+        # one declarative preset over the flags above; an explicit
+        # --bucket-bytes (already in the config) is kept, otherwise the
+        # pyprof roofline resolves "auto" at trainer construction
+        cfg = cfg.fastpath()
 
     mesh = cfg.initialize_mesh()
     trainer = GPTHybridTrainer(cfg, mesh)

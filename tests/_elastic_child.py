@@ -38,10 +38,18 @@ M, MB = 2, 1  # microbatches x micro-batch rows (per dp rank)
 DATA_ROWS, DATA_SEED = 64, 1
 
 
-def build_trainer_and_data(devices):
+def build_trainer_and_data(devices, fastpath=True):
     """(trainer, data_iterator, mesh) on the FIRST ``len(devices)`` of the
     caller's jax devices — shared by the child (2-device process) and the
-    parent's in-process reference run (first 2 of its 8)."""
+    parent's in-process reference run (first 2 of its 8). The trainer
+    runs the COMPOUND fastpath configuration (TrainConfig.fastpath:
+    ZeRO-1 with the backward-interleaved per-bucket RS/AG chains +
+    selective remat) with a pinned small bucket grid, so the
+    kill-and-resume contract is proven on the interleaved-apply program
+    with a real multi-bucket (bucket-major) shard layout — the plain
+    trainer's elastic loop stays covered in-process by tests/
+    test_elastic.py and the dryrun gate's elastic leg. ``fastpath=False``
+    keeps the plain config reachable for debugging."""
     import jax
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -63,6 +71,11 @@ def build_trainer_and_data(devices):
                           micro_batch_size=MB),
         optimizer=OptimizerConfig(name="adam", lr=1e-2, weight_decay=0.0),
         opt_level="O0")
+    if fastpath:
+        # pinned grid: the tiny model sits below the roofline candidate
+        # ladder ("auto" would resolve to one bucket and skip the
+        # bucket-major layout this leg exists to prove)
+        cfg = cfg.fastpath(bucket_bytes=2048)
     mesh = cfg.initialize_mesh(devices=devices)
     trainer = GPTHybridTrainer(cfg, mesh)
 

@@ -22,7 +22,7 @@ import re
 import jax
 
 __all__ = ["jaxpr_str", "count_primitives", "collective_census",
-           "iter_eqns", "count_eqns", "eqn_axes"]
+           "iter_eqns", "count_eqns", "eqn_axes", "flat_materializations"]
 
 
 def eqn_axes(eqn) -> tuple:
@@ -77,6 +77,27 @@ def _sub_jaxprs(value):
     elif isinstance(value, (list, tuple)):
         for item in value:
             yield from _sub_jaxprs(item)
+
+
+def flat_materializations(jaxpr, size, dtype="float32") -> list:
+    """Primitive names of equations that OUTPUT a 1-D ``dtype`` array of
+    exactly ``size`` elements — the structural detector for "the full
+    padded flat gradient materialized" (the barrier the span-local
+    bucketed ravel/unravel removes). Wrapper equations carrying
+    sub-jaxprs (shard_map/pjit/scan/...) are excluded: their outvars are
+    aggregate *views* (e.g. the global aval of a sharded ZeRO master),
+    not buffers the per-device program builds — any real materialization
+    inside them is a leaf equation this walk still visits."""
+    out = []
+    for eqn in iter_eqns(jaxpr):
+        if any(True for v in eqn.params.values() for _ in _sub_jaxprs(v)):
+            continue
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            if getattr(aval, "ndim", None) == 1 and aval.size == size \
+                    and str(getattr(aval, "dtype", "")) == dtype:
+                out.append(eqn.primitive.name)
+    return out
 
 
 def count_eqns(fn_or_jaxpr, name, *args, where=None) -> int:

@@ -14,6 +14,14 @@ module (one source for the recipe), and the two legs split the
 ``fp32_on_disk`` settings between them so both on-disk layouts are
 proven.
 
+As of PR 8 the child trains the COMPOUND ``fastpath`` configuration
+(ZeRO-1 with the backward-interleaved per-bucket RS→math→AG apply on a
+multi-bucket bucket-major shard layout + selective remat) — the
+kill-and-resume contract is proven on the interleaved-apply program,
+including the ``bucket_stamp`` layout guard every restore passes
+through. The plain trainer's elastic loop stays covered in-process by
+``tests/test_elastic.py`` and the dryrun gate's elastic leg.
+
 Children share one persistent XLA compilation cache dir, so only the
 first pays the compile.
 """

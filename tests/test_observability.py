@@ -1250,3 +1250,111 @@ class TestCheckElasticExits:
         mod = self._mod()
         ok, lines = mod.check(repo=str(tmp_path))
         assert not ok and any("MISSING" in l for l in lines)
+
+
+# ---------------------------------------------------------------------------
+# bench-config field contract (declarative legs name real config fields)
+# ---------------------------------------------------------------------------
+
+class TestCheckBenchConfigs:
+    def test_script_passes_on_this_tree(self):
+        proc = subprocess.run(
+            [sys.executable, "scripts/check_bench_configs.py"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        # the declarative trainer legs are both checked
+        assert "BENCH_TRAIN_CONFIGS['gpt_base']" in proc.stdout
+        assert "BENCH_TRAIN_CONFIGS['gpt_fast']" in proc.stdout
+        # the _gpt_train_step cfg_overrides passthrough is checked too
+        assert "_gpt_train_step call" in proc.stdout
+
+    def _mod(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "check_bench_configs", "scripts/check_bench_configs.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def _seed_repo(self, tmp_path, bench_src):
+        (tmp_path / "apex_tpu" / "models").mkdir(parents=True)
+        (tmp_path / "apex_tpu" / "config.py").write_text(
+            "import dataclasses\n"
+            "@dataclasses.dataclass(frozen=True)\n"
+            "class ModelConfig:\n"
+            "    name: str = 'gpt'\n"
+            "    remat_policy: str = None\n"
+            "@dataclasses.dataclass(frozen=True)\n"
+            "class ParallelConfig:\n"
+            "    tensor_model_parallel_size: int = 1\n"
+            "@dataclasses.dataclass(frozen=True)\n"
+            "class BatchConfig:\n"
+            "    global_batch_size: int = 64\n"
+            "@dataclasses.dataclass(frozen=True)\n"
+            "class OptimizerConfig:\n"
+            "    name: str = 'adam'\n"
+            "    zero: int = 0\n"
+            "@dataclasses.dataclass(frozen=True)\n"
+            "class TrainConfig:\n"
+            "    model: ModelConfig = ModelConfig()\n"
+            "    parallel: ParallelConfig = ParallelConfig()\n"
+            "    batch: BatchConfig = BatchConfig()\n"
+            "    optimizer: OptimizerConfig = OptimizerConfig()\n"
+            "    ddp_bucket_bytes: int = None\n")
+        (tmp_path / "apex_tpu" / "models" / "gpt.py").write_text(
+            "import dataclasses\n"
+            "@dataclasses.dataclass(frozen=True)\n"
+            "class GPTConfig:\n"
+            "    hidden_size: int = 768\n"
+            "    remat_policy: str = None\n")
+        (tmp_path / "bench.py").write_text(bench_src)
+
+    def test_detects_renamed_field(self, tmp_path):
+        """The failure mode the check exists for: a key that no longer
+        names a dataclass field (renamed flag) is flagged, at the top
+        level and inside nested sections — and in an emitted
+        BENCH_CONFIGS.json config block."""
+        mod = self._mod()
+        self._seed_repo(
+            tmp_path,
+            "BENCH_TRAIN_CONFIGS = {\n"
+            "  'leg': {'model': {'remat_policy': 'selective',\n"
+            "                    'remat_mode': 'full'},\n"
+            "          'bucket_bytes': 4096,\n"
+            "          'optimizer': {'zero': 1}},\n"
+            "}\n")
+        (tmp_path / "BENCH_CONFIGS.json").write_text(
+            '[{"metric": "m", "config": {"ddp_bucket_bytes": 1,'
+            ' "optimizer": {"zero_stage": 1}}}]')
+        ok, lines = mod.check(repo=str(tmp_path))
+        assert not ok
+        unknown = [l for l in lines if l.startswith("UNKNOWN")]
+        assert any("model.'remat_mode'" in l for l in unknown)
+        assert any("'bucket_bytes'" in l for l in unknown)
+        assert any("optimizer.'zero_stage'" in l
+                   and "BENCH_CONFIGS.json" in l for l in unknown)
+        # valid keys in the same legs are NOT flagged
+        assert not any("remat_policy" in l for l in unknown)
+        assert not any("'zero'" in l for l in unknown)
+
+    def test_detects_stale_gpt_step_keyword(self, tmp_path):
+        mod = self._mod()
+        self._seed_repo(
+            tmp_path,
+            "BENCH_TRAIN_CONFIGS = {}\n"
+            "def _gpt_train_step(batch=8, seq=1024, **cfg_overrides):\n"
+            "    pass\n"
+            "def bench_gpt():\n"
+            "    _gpt_train_step(batch=8, hidden_size=768)\n"
+            "def bench_bad():\n"
+            "    _gpt_train_step(hidden_dims=768)\n")
+        ok, lines = mod.check(repo=str(tmp_path))
+        assert not ok
+        unknown = [l for l in lines if l.startswith("UNKNOWN")]
+        assert len(unknown) == 1 and "hidden_dims" in unknown[0]
+
+    def test_missing_table_fails(self, tmp_path):
+        mod = self._mod()
+        self._seed_repo(tmp_path, "x = 1\n")
+        ok, lines = mod.check(repo=str(tmp_path))
+        assert not ok and any("BENCH_TRAIN_CONFIGS" in l for l in lines)
