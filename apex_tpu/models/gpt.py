@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from apex_tpu.normalization import fused_layer_norm_affine
 from apex_tpu.ops.dropout import dropout
 from apex_tpu.remat import RematPolicy, tag as _remat_tag
-from apex_tpu.ops.flash_attention import flash_attention
+from apex_tpu.ops.flash_attention import decode_attention, flash_attention
 from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
 from apex_tpu.transformer import tensor_parallel as tp_mod
 from apex_tpu.transformer.parallel_state import TENSOR_AXIS
@@ -244,7 +244,7 @@ class GPTModel:
 
     @jax.named_scope("gpt_attention")
     def _attention(self, lp: dict, x: jnp.ndarray,
-                   attn_seed=None) -> jnp.ndarray:
+                   attn_seed=None, collect_kv: bool = False):
         cfg = self.cfg
         b = x.shape[0]
         local_heads = cfg.num_attention_heads // cfg.tensor_model_parallel_size
@@ -264,7 +264,11 @@ class GPTModel:
                               checkpoint_names=self.remat_policy.uses_names)
         ctx = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(b, s, -1)
         out, _ = self.proj(lp["proj"], ctx)
-        return self._tag(out, "attn_proj_out")
+        out = self._tag(out, "attn_proj_out")
+        if collect_kv:
+            # prefill: the serving cache wants this layer's K/V alongside
+            return out, (k, v)
+        return out
 
     @jax.named_scope("gpt_mlp")
     def _mlp(self, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
@@ -276,17 +280,22 @@ class GPTModel:
         out, _ = self.fc2(lp["fc2"], h)
         return self._tag(out, "mlp_fc2_out")
 
-    def _layer(self, lp: dict, x: jnp.ndarray, lrng=None) -> jnp.ndarray:
+    def _layer(self, lp: dict, x: jnp.ndarray, lrng=None,
+               collect_kv: bool = False):
         cfg = self.cfg
         attn_seed = lrng["attn_seed"] if lrng is not None else None
-        a = self._attention(lp, self._ln(lp["ln1"], x), attn_seed)
+        a = self._attention(lp, self._ln(lp["ln1"], x), attn_seed,
+                            collect_kv=collect_kv)
+        if collect_kv:
+            a, kv = a
         if lrng is not None:
             a = dropout(a, cfg.hidden_dropout, lrng["h1"])
         x = x + a
         m = self._mlp(lp, self._ln(lp["ln2"], x))
         if lrng is not None:
             m = dropout(m, cfg.hidden_dropout, lrng["h2"])
-        return x + m
+        x = x + m
+        return (x, kv) if collect_kv else x
 
     def _layer_rngs(self, dropout_rng: jax.Array) -> dict:
         """Per-layer dropout randomness, stacked (num_layers, ...) for the
@@ -443,6 +452,169 @@ class GPTModel:
                 return jnp.sum(per_tok * loss_mask) / jnp.maximum(
                     jnp.sum(loss_mask), 1.0)
             return jnp.mean(per_tok)
+
+    # -- serving: KV-cached prefill/decode ----------------------------------
+
+    def _require_cacheable(self):
+        cfg = self.cfg
+        if cfg.tensor_model_parallel_size != 1 or cfg.sequence_parallel:
+            raise NotImplementedError(
+                "the KV-cached serving path runs tp=1 (serve-mesh "
+                "resharding is ROADMAP item 3); got tp="
+                f"{cfg.tensor_model_parallel_size}, sequence_parallel="
+                f"{cfg.sequence_parallel}")
+
+    def _decode_layer(self, lp: dict, x: jnp.ndarray, layer_cache,
+                      lengths: jnp.ndarray):
+        """One layer of the decode step: ``x`` is ``(S, 1, hidden)`` (one
+        token per slot), ``layer_cache`` this layer's ``(ck, cv, ksc,
+        vsc)`` cache slices. Returns ``(x, (k_new, v_new))`` — the new
+        token's K/V ``(S, H, D)``, appended to the cache by the caller
+        AFTER the scan (the kernel merges the current token itself, so
+        the cache is read-only inside the layer stack)."""
+        cfg = self.cfg
+        h = self._ln(lp["ln1"], x)
+        with jax.named_scope("gpt_attention"):
+            qkv, _ = self.qkv(lp["qkv"], h)       # (S, 1, 3*hidden)
+            S = qkv.shape[0]
+            qkv = qkv.reshape(S, cfg.num_attention_heads, 3 * cfg.head_dim)
+            q, k_new, v_new = jnp.split(qkv, 3, axis=-1)   # (S, H, D)
+            ck, cv, ksc, vsc = layer_cache
+            ctx = decode_attention(q, ck, cv, lengths, k_new=k_new,
+                                   v_new=v_new, k_scale=ksc, v_scale=vsc,
+                                   use_pallas=cfg.use_flash)
+            out, _ = self.proj(lp["proj"], ctx.reshape(S, 1, -1))
+        x = x + out
+        x = x + self._mlp(lp, self._ln(lp["ln2"], x))
+        return x, (k_new, v_new)
+
+    def forward(self, params: dict, tokens: jnp.ndarray,
+                dropout_rng: Optional[jax.Array] = None,
+                kv_cache=None, positions: Optional[jnp.ndarray] = None,
+                slot=None, prompt_len=None,
+                last_logit_only: bool = False,
+                active: Optional[jnp.ndarray] = None):
+        """The cache-threading entry point (docs/SERVING.md).
+
+        Without ``kv_cache`` this is :meth:`__call__`. With a
+        :class:`~apex_tpu.serving.cache.KVCache` it dispatches on ``slot``:
+
+        - **prefill** (``slot`` given): ``tokens`` is ``(1, P)`` — the
+          ordinary causal forward (same flash path, same layer scan as
+          training) that ALSO collects every layer's K/V and writes them
+          into cache slot ``slot``, cursor set to ``prompt_len``
+          (default ``P``; right-pad shorter prompts). Returns
+          ``(logits (1, P, vocab), new_cache)``.
+        - **decode** (no ``slot``): ``tokens`` is ``(max_seqs, 1)`` — one
+          token per slot, every slot stepping together under a fixed
+          shape. Attention runs the decode kernel over each slot's cached
+          prefix, the new K/V are appended at each slot's own cursor, and
+          cursors advance. ``positions`` (default: the cache cursors)
+          indexes the position embedding. Returns
+          ``(logits (max_seqs, vocab), new_cache)``.
+
+        ``active`` (decode only): ``(max_seqs,)`` bool — slots NOT in it
+        keep a frozen cursor (their garbage token lands at the same
+        position each step and the next prefill overwrites it), so free
+        slots never grow an attention prefix. Default: all advance.
+
+        ``last_logit_only`` (prefill only): project the vocab head for
+        JUST the position ``prompt_len - 1`` — logits come back
+        ``(1, 1, vocab)``. The full-prompt head is the largest matmul in
+        a prefill and a serving admission samples exactly one row of it;
+        the serving engine always sets this (parity tests use the
+        default full logits).
+
+        Both legs are inference-mode (no dropout) and are meant to be
+        AOT-compiled with the cache donated — see
+        :class:`apex_tpu.serving.engine.ServingEngine`.
+        """
+        if kv_cache is None:
+            return self(params, tokens, dropout_rng)
+        self._require_cacheable()
+        if slot is not None:
+            return self._prefill_forward(params, tokens, kv_cache, slot,
+                                         prompt_len, last_logit_only)
+        return self._decode_forward(params, tokens, kv_cache, positions,
+                                    active)
+
+    def _prefill_forward(self, params, tokens, cache, slot, prompt_len,
+                         last_logit_only=False):
+        cfg = self.cfg
+        b, P = tokens.shape
+        if b != 1:
+            raise ValueError(f"prefill is per-request: tokens must be "
+                             f"(1, P), got {tokens.shape}")
+        if P > cache.max_len:
+            raise ValueError(f"prompt window {P} exceeds cache max_len "
+                             f"{cache.max_len}")
+        if prompt_len is None:
+            prompt_len = P
+        elif isinstance(prompt_len, int):
+            # a cursor past the written window would make every later
+            # decode read stale cache — reject statically when we can
+            if not 0 < prompt_len <= P:
+                raise ValueError(f"prompt_len {prompt_len} outside the "
+                                 f"written window (1, {P}]")
+        else:
+            # traced (the AOT engine path): clamp for the same reason
+            prompt_len = jnp.clip(jnp.asarray(prompt_len, jnp.int32), 1,
+                                  P)
+        x = self.embed(params, tokens)
+
+        def body(x, lp):
+            return self._layer(lp, x, collect_kv=True)
+
+        x, (k_all, v_all) = scan_stable_vma(body, x, params["layers"],
+                                            unroll=cfg.layer_scan_unroll)
+        x = self._ln(params["final_ln"], x)
+        if last_logit_only:
+            # the head is per-position: gathering the hidden row BEFORE
+            # the vocab projection skips (P-1)/P of the prefill's
+            # largest matmul
+            x = jax.lax.dynamic_slice_in_dim(
+                x, jnp.asarray(prompt_len, jnp.int32) - 1, 1, axis=1)
+        logits = self.logits(params, x)
+        # ys stacked (L, 1, H, P, D) -> (L, H, P, D) for the slot write
+        cache = cache.write_prompt(k_all[:, 0], v_all[:, 0], slot,
+                                   prompt_len)
+        return logits, cache
+
+    def _decode_forward(self, params, tokens, cache, positions,
+                        active=None):
+        cfg = self.cfg
+        if tokens.ndim != 2 or tokens.shape[1] != 1:
+            raise ValueError(f"decode tokens must be (max_seqs, 1), got "
+                             f"{tokens.shape}")
+        if positions is None:
+            positions = cache.lengths
+        with jax.named_scope("gpt_embed"):
+            h = self.embedding(params["embedding"]["word"], tokens)
+            pos = jnp.take(
+                params["embedding"]["position"],
+                jnp.clip(positions, 0, cfg.max_position_embeddings - 1),
+                axis=0)[:, None]
+            x = (h + pos).astype(cfg.compute_dtype)
+
+        xs = (params["layers"], cache.k, cache.v)
+        if cache.quantized:
+            xs = xs + (cache.k_scale, cache.v_scale)
+
+        def body(x, lp_c):
+            lp, ck, cv = lp_c[:3]
+            ksc, vsc = (lp_c[3], lp_c[4]) if cache.quantized else (None,
+                                                                   None)
+            return self._decode_layer(lp, x, (ck, cv, ksc, vsc),
+                                      cache.lengths)
+
+        x, (k_new, v_new) = scan_stable_vma(body, x, xs,
+                                            unroll=cfg.layer_scan_unroll)
+        x = self._ln(params["final_ln"], x)
+        logits = self.logits(params, x)[:, 0]
+        # `active` (``(max_seqs,)`` bool): only those slots advance their
+        # cursor — free slots must not creep one garbage position per
+        # step (see KVCache.append)
+        return logits, cache.append(k_new, v_new, active)
 
     def sp_grad_sync(self, grads: dict) -> dict:
         """Megatron-LM allreduces the grads of ``sequence_parallel``-marked

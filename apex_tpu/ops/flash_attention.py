@@ -55,7 +55,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flash_attention", "mha_reference", "supports_flash",
-           "dropout_keep_mask"]
+           "dropout_keep_mask", "decode_attention"]
 
 NEG_INF = -1e30
 
@@ -127,7 +127,16 @@ def dropout_keep_mask(seed, b, h, sq, sk, rate):
 def supports_flash(sq: int, sk: int, d: int, block_q: int, block_k: int) -> bool:
     """Eligibility for the Pallas path (cf. the reference's per-kernel seqlen
     gates, ``fused_softmax.py:159-179`` / ``setup.py:544-560`` — here the gate
-    is only tile alignment, not a seqlen cap)."""
+    is only tile alignment, not a seqlen cap).
+
+    Decode shapes (``sq == 1`` against a cached ``sk``) are eligible too:
+    a single query row rides one padded sublane tile (``block_q == 1``), so
+    only the key-side tiling gates. Callers historically assumed
+    ``sq == sk`` — the KV-cached decode path is the second caller family.
+    """
+    if sq == 1:
+        return (sk % block_k == 0 and d % 8 == 0 and block_k % 128 == 0
+                and block_q == 1)
     return (sq % block_q == 0 and sk % block_k == 0 and d % 8 == 0
             and block_q % 8 == 0 and block_k % 128 == 0)
 
@@ -153,18 +162,29 @@ def _norm_segment_ids(segment_ids, sq, sk):
 def mha_reference(q, k, v, bias=None, causal=False,
                   softmax_scale: Optional[float] = None,
                   dropout_rate: float = 0.0, dropout_seed=None,
-                  segment_ids=None):
+                  segment_ids=None, kv_length=None):
     """Plain-XLA attention; the parity reference for the kernel (the role of
     the Python attention in ``reference:apex/contrib/test/fmha/test_fmha.py``).
     With ``dropout_rate > 0`` it applies the *same* counter-based mask as the
     Pallas kernels, so fallback and kernel paths agree bitwise in expectation
-    and exactly for a given seed."""
+    and exactly for a given seed.
+
+    ``kv_length``: the KV-cache oracle path — an int array ``(b,)`` giving
+    the number of VALID cache entries per batch row; key positions at or
+    beyond it are masked out (the ground truth for
+    :func:`decode_attention`, whose ``k``/``v`` are preallocated
+    ``max_len`` caches carrying garbage past the write cursor). Rows with
+    length 0 produce an exactly-zero output, matching the kernel."""
     if softmax_scale is None:
         softmax_scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * softmax_scale
     if bias is not None:
         s = s + bias.astype(jnp.float32)
+    if kv_length is not None:
+        lengths = jnp.asarray(kv_length).astype(jnp.int32)
+        col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, k.shape[2]), 3)
+        s = jnp.where(col < lengths[:, None, None, None], s, NEG_INF)
     if segment_ids is not None:
         q_ids, kv_ids = _norm_segment_ids(segment_ids, q.shape[2], k.shape[2])
         s = jnp.where((q_ids[:, None, :, None] == kv_ids[:, None, None, :]),
@@ -893,7 +913,9 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
     if dropout_rate > 0.0 and dropout_seed is None:
         raise ValueError("dropout_rate > 0 requires dropout_seed")
     if block_q is None:
-        block_q = _auto_block(sq, (512, 256, 128, 64, 32, 16, 8)) or 128
+        # decode shape: a lone query row rides one padded sublane tile
+        block_q = (1 if sq == 1 else
+                   _auto_block(sq, (512, 256, 128, 64, 32, 16, 8)) or 128)
     if block_k is None:
         block_k = _auto_block(sk) or 128
     if use_pallas is None:
@@ -957,3 +979,256 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
     with jax.named_scope("flash_attention"):
         out = fn(q3, k3, v3, bias4, seed, qseg, kseg)
     return out.reshape(b, h, sq, d)
+
+
+# ---------------------------------------------------------------------------
+# decode kernel — single-query attention over a preallocated KV cache
+# ---------------------------------------------------------------------------
+#
+# The serving fast path (docs/SERVING.md). The training kernels above are
+# built for sq == sk score tiles; autoregressive decode is the opposite
+# regime — ONE query row per sequence against a long cached key stripe, a
+# memory-bound streaming reduction with no backward pass (the reference
+# ships a separate inference attention family, fmhalib /
+# fast_multihead_attn, for exactly this reason). This kernel:
+#
+# - grids ``(b*h, max_len/block_k)`` with the cache blocks innermost and
+#   streams the flash-LSE running ``(m, l, acc)`` in VMEM scratch across
+#   them (the same online-softmax recurrence as ``_fwd_kernel``, one query
+#   row wide — the row rides a padded sublane tile);
+# - masks by a per-sequence integer write cursor (``lengths``) held in
+#   SMEM, and SKIPS the compute of cache blocks entirely past the cursor
+#   (a sequence at position t prices O(t) MXU work). NOTE the grid — and
+#   therefore the pipelined HBM->VMEM block fetches — is still shaped by
+#   max_len: v1 streams the full stripe and skips only the math, so the
+#   memory-bound cost is O(max_len) per slot per step. Bounding the
+#   fetches too (scalar-prefetched per-slot block counts driving manual
+#   DMA) is the known next optimization; docs/SERVING.md carries the
+#   same caveat so capacity/roofline readings stay honest;
+# - optionally dequantizes an int8 cache blockwise in VMEM against
+#   per-(position, head) fp32 scales — the cache stays int8 in HBM, which
+#   is where a decode step's bytes actually go;
+# - returns the per-row logsumexp so the caller can fold in the CURRENT
+#   token's k/v with one exact two-way LSE merge (``_merge_current``) —
+#   the cache is read before the new token is appended, so the kernel
+#   never needs a variable-position write. Empty rows (length 0) return
+#   lse = -inf, the correct identity for that merge (the training
+#   kernel's +inf convention exists only for its backward).
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, ksc_ref, vsc_ref, o_ref,
+                   lse_ref, acc_ref, m_ref, l_ref, *, scale, block_k, n_kv):
+    bh, j = pl.program_id(0), pl.program_id(1)
+    length = len_ref[bh]
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # skip the COMPUTE of cache blocks past the write cursor (the
+    # pipeline still fetches them — see the section comment)
+    @pl.when(j * block_k < length)
+    def _():
+        q = q_ref[0].astype(jnp.float32)          # (1, d)
+        k = k_ref[0]                              # (block_k, d)
+        v = v_ref[0]
+        if ksc_ref is not None:
+            # int8 cache: dequantize blockwise in VMEM against the
+            # per-(position, head) scales — HBM only ever holds int8
+            k = k.astype(jnp.float32) * ksc_ref[0][:, None]
+            v = v.astype(jnp.float32) * vsc_ref[0][:, None]
+        s = jax.lax.dot_general(q, k.astype(jnp.float32),
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        col = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        s = jnp.where(col < length, s, NEG_INF)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(col < length, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[:] = m_new
+        pv = jax.lax.dot_general(p, v.astype(jnp.float32),
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * corr + pv
+
+    @pl.when(j == n_kv - 1)
+    def _():
+        l = l_ref[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        # -inf (NOT the training kernels' +inf): the empty row must be
+        # the identity of the two-way merge with the current token
+        lse_ref[0] = jnp.where(l == 0.0, -jnp.inf,
+                               m_ref[:] + jnp.log(safe_l))
+
+
+def _decode_pallas(q3, k3, v3, lengths_bh, ksc, vsc, *, scale, block_k):
+    bh, T, d = k3.shape
+    n_kv = T // block_k
+    has_scale = ksc is not None
+
+    q_spec = pl.BlockSpec((1, 1, d), lambda b, j: (b, 0, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0),
+                           memory_space=pltpu.VMEM)
+    sc_spec = pl.BlockSpec((1, block_k), lambda b, j: (b, j),
+                           memory_space=pltpu.VMEM)
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM), q_spec, kv_spec,
+                kv_spec]
+    args = [lengths_bh, q3, k3, v3]
+    if has_scale:
+        in_specs += [sc_spec, sc_spec]
+        args += [ksc, vsc]
+
+    def kernel(*refs):
+        refs = list(refs)
+        len_ref, q_ref, k_ref, v_ref = refs[:4]
+        nxt = 4
+        ksc_ref = refs[nxt] if has_scale else None
+        vsc_ref = refs[nxt + 1] if has_scale else None
+        nxt += 2 * has_scale
+        o_ref, lse_ref, acc, m, l = refs[nxt:]
+        _decode_kernel(len_ref, q_ref, k_ref, v_ref, ksc_ref, vsc_ref,
+                       o_ref, lse_ref, acc, m, l, scale=scale,
+                       block_k=block_k, n_kv=n_kv)
+
+    out_dtype = q3.dtype if q3.dtype != jnp.int8 else jnp.float32
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, n_kv),
+        in_specs=in_specs,
+        out_specs=(q_spec,
+                   pl.BlockSpec((1, 1, 1), lambda b, j: (b, 0, 0),
+                                memory_space=pltpu.VMEM)),
+        out_shape=(jax.ShapeDtypeStruct((bh, 1, d), out_dtype),
+                   jax.ShapeDtypeStruct((bh, 1, 1), jnp.float32)),
+        scratch_shapes=[pltpu.VMEM((1, d), jnp.float32),
+                        pltpu.VMEM((1, 1), jnp.float32),
+                        pltpu.VMEM((1, 1), jnp.float32)],
+        interpret=_interp(),
+    )(*args)
+    return out, lse
+
+
+def _dequant(x, scale):
+    """int8 cache block -> fp32 against per-(position, head) scales
+    ``(b, h, T)``."""
+    return x.astype(jnp.float32) * scale[..., None]
+
+
+def _merge_current(out, lse, q, k_new, v_new, scale, out_dtype):
+    """Exact two-way logsumexp merge of the cached-prefix attention
+    ``(out, lse)`` with the CURRENT token's ``(k_new, v_new)`` — the new
+    token always attends to itself, and merging here (instead of writing
+    it into the cache first) keeps the kernel free of variable-position
+    writes. All fp32; an empty prefix (lse == -inf) reduces to exactly
+    ``v_new``."""
+    q32 = q.astype(jnp.float32)
+    s_new = jnp.sum(q32 * k_new.astype(jnp.float32), axis=-1) * scale  # (b,h)
+    m = jnp.maximum(lse, s_new)
+    a_old = jnp.exp(lse - m)           # 0 when the prefix is empty
+    a_new = jnp.exp(s_new - m)
+    merged = (a_old[..., None] * out.astype(jnp.float32)
+              + a_new[..., None] * v_new.astype(jnp.float32))
+    return (merged / (a_old + a_new)[..., None]).astype(out_dtype)
+
+
+def decode_attention(q, k, v, lengths, k_new=None, v_new=None,
+                     k_scale=None, v_scale=None,
+                     softmax_scale: Optional[float] = None,
+                     block_k: Optional[int] = None,
+                     use_pallas: Optional[bool] = None):
+    """Single-query attention over a preallocated KV cache — the serving
+    decode kernel (see the section comment above).
+
+    Args:
+      q: ``(b, h, d)`` — one query row per sequence slot.
+      k, v: ``(b, h, max_len, d)`` preallocated caches (bf16/fp32, or int8
+        with ``k_scale``/``v_scale``). Entries at or past ``lengths`` are
+        never read.
+      lengths: ``(b,)`` int — the per-slot write cursor: number of valid
+        cache positions (the already-written PREFIX; the current token is
+        NOT in the cache — pass it as ``k_new``/``v_new``).
+      k_new, v_new: optional ``(b, h, d)`` — the current token's key/value,
+        folded in by an exact two-way LSE merge. With an empty prefix the
+        result is exactly ``v_new`` (softmax over one position).
+      k_scale, v_scale: ``(b, h, max_len)`` fp32 per-(position, head)
+        dequantization scales, required iff the cache dtype is int8.
+      block_k: cache streaming block (default: largest of 512/256/128
+        dividing ``max_len``).
+
+    Returns ``(b, h, d)`` in ``q.dtype``. Rows whose prefix is empty AND
+    have no ``k_new`` are exactly zero.
+
+    Falls back to the XLA reference (:func:`mha_reference` with its
+    ``kv_length`` oracle path) when the cache isn't tile-aligned.
+    """
+    b, h, d = q.shape
+    T = k.shape[2]
+    if k.shape != (b, h, T, d) or v.shape != (b, h, T, d):
+        raise ValueError(f"cache shapes {k.shape}/{v.shape} do not match "
+                         f"q {q.shape} with max_len {T}")
+    quantized = k.dtype == jnp.int8
+    if quantized and (k_scale is None or v_scale is None):
+        raise ValueError("int8 caches need k_scale/v_scale")
+    if softmax_scale is None:
+        softmax_scale = 1.0 / math.sqrt(d)
+    if block_k is None:
+        block_k = _auto_block(T) or 128
+    if use_pallas is None:
+        use_pallas = supports_flash(1, T, d, 1, block_k)
+    elif use_pallas and not supports_flash(1, T, d, 1, block_k):
+        # a forced kernel on a misaligned cache would silently drop the
+        # T % block_k tail (or never write the output at T < block_k) —
+        # refuse instead of decoding garbage
+        raise ValueError(
+            f"use_pallas=True but cache max_len {T} / head_dim {d} are "
+            f"not tile-aligned for block_k={block_k}; pass a dividing "
+            "block_k or let use_pallas auto-select the XLA fallback")
+    lengths = jnp.asarray(lengths).astype(jnp.int32)
+
+    with jax.named_scope("decode_attention"):
+        if use_pallas:
+            q3 = q.reshape(b * h, 1, d)
+            k3 = k.reshape(b * h, T, d)
+            v3 = v.reshape(b * h, T, d)
+            # per-slot cursor fanned out per head for the SMEM lookup
+            lengths_bh = jnp.repeat(lengths, h)
+            ksc = k_scale.reshape(b * h, T) if quantized else None
+            vsc = v_scale.reshape(b * h, T) if quantized else None
+            out3, lse3 = _decode_pallas(q3, k3, v3, lengths_bh, ksc, vsc,
+                                        scale=float(softmax_scale),
+                                        block_k=block_k)
+            out = out3.reshape(b, h, d)
+            lse = lse3.reshape(b, h)
+        else:
+            # XLA fallback, same math as the kernel (and as
+            # mha_reference's kv_length oracle — the parity tests pin all
+            # three together): ONE masked score pass feeds both the
+            # output and the lse the merge needs
+            kd = _dequant(k, k_scale) if quantized else k
+            vd = _dequant(v, v_scale) if quantized else v
+            s = jnp.einsum("bhd,bhkd->bhk", q.astype(jnp.float32),
+                           kd.astype(jnp.float32)) * softmax_scale
+            col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, T), 2)
+            valid = col < lengths[:, None, None]
+            s = jnp.where(valid, s, NEG_INF)
+            m = jnp.max(s, axis=-1, keepdims=True)
+            # fully-masked rows have m == NEG_INF and exp(s - m) == 1 on
+            # every entry — zero them explicitly (the kernels' rule)
+            p = jnp.where(valid, jnp.exp(s - m), 0.0)
+            l = jnp.sum(p, axis=-1, keepdims=True)
+            safe_l = jnp.where(l == 0.0, 1.0, l)
+            out = jnp.einsum("bhk,bhkd->bhd", p / safe_l,
+                             vd.astype(jnp.float32))
+            lse = jnp.where(lengths[:, None] == 0, -jnp.inf,
+                            (m + jnp.log(safe_l))[..., 0])
+        if k_new is not None:
+            out = _merge_current(out, lse, q, k_new, v_new,
+                                 float(softmax_scale), q.dtype)
+        return out.astype(q.dtype)

@@ -66,6 +66,10 @@ DEFAULT_REGIONS: Tuple[str, ...] = (
     # sync / schedule / optimizer machinery
     "apex_ddp_allreduce", "apex_ddp_bucketed_allreduce", "sync_bn_stats",
     "pipeline_tick", "optimizer_step",
+    # serving fast path: the decode kernel carves out of gpt_attention;
+    # the step scopes catch the non-model work (sampling, cache append)
+    # and split prefill from decode programs in a combined trace
+    "decode_attention", "serve_prefill", "serve_decode",
 )
 
 UNATTRIBUTED = "(unattributed)"

@@ -1,0 +1,18 @@
+"""Serving fast path: KV-cached decode for the GPT model.
+
+The inference half of the library (docs/SERVING.md): a fixed-layout
+:class:`~apex_tpu.serving.cache.KVCache`, AOT-compiled prefill/decode
+steps with donated cache buffers
+(:class:`~apex_tpu.serving.engine.ServingEngine`), fixed-shape sampling
+(:mod:`~apex_tpu.serving.sampling`), and a continuous slot batcher
+(:class:`~apex_tpu.serving.scheduler.SlotScheduler`) emitting the
+``serve/*`` metric family.
+"""
+
+from apex_tpu.serving.cache import KVCache, cache_bytes_per_slot
+from apex_tpu.serving.engine import ServingEngine
+from apex_tpu.serving.sampling import sample_tokens
+from apex_tpu.serving.scheduler import Completion, Request, SlotScheduler
+
+__all__ = ["KVCache", "cache_bytes_per_slot", "ServingEngine",
+           "sample_tokens", "Completion", "Request", "SlotScheduler"]

@@ -1,0 +1,215 @@
+"""KV cache: the fixed-layout pytree the serving fast path decodes from.
+
+One preallocated buffer pair per layer stack — ``k``/``v`` shaped
+``(num_layers, max_seqs, num_heads, max_len, head_dim)`` — plus a per-slot
+integer write cursor ``lengths``. The layout is chosen so that
+
+- the layer dim scans (``lax.scan`` over the GPT stack feeds each layer
+  its ``(S, H, T, D)`` slice, exactly like the stacked params);
+- each ``(slot, head)``'s positions are contiguous along ``T`` — the
+  stripe the decode kernel streams blockwise
+  (:func:`apex_tpu.ops.flash_attention.decode_attention`);
+- every program over it is FIXED SHAPE: admission, retirement and
+  variable sequence lengths are all expressed through the cursor, never
+  through array shapes, so the AOT-compiled decode step never recompiles.
+
+Writes are in-place-friendly by construction: :meth:`KVCache.append` is
+one batched ``dynamic_update_slice`` (a scatter over slots) appending one
+token to every slot at its own cursor, and :meth:`KVCache.write_prompt`
+is a single slot-indexed ``dynamic_update_slice`` — both alias their
+donated operands under ``jit`` (asserted in ``tests/test_serving.py``),
+so a decode step allocates nothing.
+
+``dtype=jnp.int8`` stores the cache quantized with per-(position, head)
+fp32 scales (symmetric absmax over the head dim, quantized at write
+time — every token is quantized against its own range, so there is no
+prefill-vs-decode calibration order to get wrong). HBM cost per token
+drops 2x vs bf16 at ~6% scale overhead; the decode kernel dequantizes
+blockwise in VMEM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["KVCache", "cache_bytes_per_slot"]
+
+# floor for the absmax quantization scale: keeps an all-zero row (e.g. a
+# never-written slot) from producing 0/0 at dequantization
+_MIN_SCALE = 1e-8
+
+
+def _quantize(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 over the trailing (head) dim: ``(..., D)`` ->
+    ``(int8 (..., D), fp32 scale (...))``."""
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0,
+        _MIN_SCALE)
+    q = jnp.round(x.astype(jnp.float32) / scale[..., None])
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class KVCache:
+    """See module docstring. Leaves: ``k``, ``v``, ``lengths`` (+
+    ``k_scale``/``v_scale`` when quantized)."""
+
+    k: jnp.ndarray                       # (L, S, H, T, D)
+    v: jnp.ndarray                       # (L, S, H, T, D)
+    lengths: jnp.ndarray                 # (S,) int32 write cursor
+    k_scale: Optional[jnp.ndarray] = None  # (L, S, H, T) fp32 iff int8
+    v_scale: Optional[jnp.ndarray] = None
+
+    # -- pytree protocol ----------------------------------------------------
+
+    def tree_flatten(self):
+        if self.quantized:
+            return ((self.k, self.v, self.lengths, self.k_scale,
+                     self.v_scale), True)
+        return ((self.k, self.v, self.lengths), False)
+
+    @classmethod
+    def tree_unflatten(cls, quantized, leaves):
+        if quantized:
+            return cls(*leaves)
+        k, v, lengths = leaves
+        return cls(k, v, lengths)
+
+    # -- shape/bookkeeping --------------------------------------------------
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def max_seqs(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def num_heads(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def head_dim(self) -> int:
+        return self.k.shape[4]
+
+    def nbytes(self) -> int:
+        """Total cache bytes (the number capacity planning divides)."""
+        return sum(leaf.size * leaf.dtype.itemsize
+                   for leaf in self.tree_flatten()[0])
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def create(cls, num_layers: int, max_seqs: int, num_heads: int,
+               max_len: int, head_dim: int,
+               dtype=jnp.bfloat16) -> "KVCache":
+        """Zero-filled cache. ``dtype=jnp.int8`` enables the quantized
+        layout (scales allocated alongside)."""
+        shape = (num_layers, max_seqs, num_heads, max_len, head_dim)
+        k = jnp.zeros(shape, dtype)
+        v = jnp.zeros(shape, dtype)
+        lengths = jnp.zeros((max_seqs,), jnp.int32)
+        if jnp.dtype(dtype) == jnp.int8:
+            # two DISTINCT buffers: a shared array would be donated twice
+            # by the AOT steps (XLA rejects duplicate donation)
+            return cls(k, v, lengths,
+                       jnp.full(shape[:-1], _MIN_SCALE, jnp.float32),
+                       jnp.full(shape[:-1], _MIN_SCALE, jnp.float32))
+        return cls(k, v, lengths)
+
+    # -- writes -------------------------------------------------------------
+
+    def _store(self, x: jnp.ndarray):
+        """(value-to-store, scale-or-None) in the cache dtype."""
+        if self.quantized:
+            return _quantize(x)
+        return x.astype(self.k.dtype), None
+
+    def append(self, k_new: jnp.ndarray, v_new: jnp.ndarray,
+               active: Optional[jnp.ndarray] = None) -> "KVCache":
+        """Append one token to EVERY slot at its own cursor:
+        ``k_new``/``v_new`` are ``(L, S, H, D)``. Only slots where
+        ``active`` (``(S,)`` bool, default all) advance their cursor —
+        an idle slot writes its garbage at a FROZEN cursor (overwritten
+        by the next prefill) instead of creeping one position per step,
+        which would otherwise grow every free slot's attention prefix
+        without bound. Slots already at ``max_len`` overwrite their last
+        position and stay saturated (the scheduler retires a sequence
+        before that matters). One batched dynamic_update_slice per
+        array — in-place on donated buffers."""
+        pos = jnp.minimum(self.lengths, self.max_len - 1)
+
+        def upd(cache_s, new_s, p):
+            # per-slot: (L, H, T, D) <- (L, H, 1, D) at position p
+            return jax.lax.dynamic_update_slice(
+                cache_s, new_s[:, :, None, :], (0, 0, p, 0))
+
+        kq, ks = self._store(k_new)
+        vq, vs = self._store(v_new)
+        k = jax.vmap(upd, in_axes=(1, 1, 0), out_axes=1)(self.k, kq, pos)
+        v = jax.vmap(upd, in_axes=(1, 1, 0), out_axes=1)(self.v, vq, pos)
+        advanced = jnp.minimum(self.lengths + 1, self.max_len)
+        if active is not None:
+            advanced = jnp.where(jnp.asarray(active, jnp.bool_),
+                                 advanced, self.lengths)
+        new = {"k": k, "v": v, "lengths": advanced}
+        if self.quantized:
+            def upd_sc(sc_s, new_s, p):
+                # per-slot: (L, H, T) <- (L, H, 1) at position p
+                return jax.lax.dynamic_update_slice(
+                    sc_s, new_s[:, :, None], (0, 0, p))
+
+            new["k_scale"] = jax.vmap(upd_sc, in_axes=(1, 1, 0),
+                                      out_axes=1)(self.k_scale, ks, pos)
+            new["v_scale"] = jax.vmap(upd_sc, in_axes=(1, 1, 0),
+                                      out_axes=1)(self.v_scale, vs, pos)
+        return dataclasses.replace(self, **new)
+
+    def write_prompt(self, k_new: jnp.ndarray, v_new: jnp.ndarray,
+                     slot, true_len) -> "KVCache":
+        """Prefill write: ``k_new``/``v_new`` are ``(L, H, P, D)`` for ONE
+        slot; positions ``[0, P)`` are overwritten and the slot's cursor
+        is set to ``true_len`` (<= P — right-padded prompts write their
+        padding too, but the cursor masks it from every future read and
+        the next appends overwrite it)."""
+        slot = jnp.asarray(slot, jnp.int32)
+        kq, ks = self._store(k_new)
+        vq, vs = self._store(v_new)
+        k = jax.lax.dynamic_update_slice(
+            self.k, kq[:, None], (0, slot, 0, 0, 0))
+        v = jax.lax.dynamic_update_slice(
+            self.v, vq[:, None], (0, slot, 0, 0, 0))
+        lengths = jax.lax.dynamic_update_slice(
+            self.lengths, jnp.asarray(true_len, jnp.int32)[None], (slot,))
+        new = {"k": k, "v": v, "lengths": lengths}
+        if self.quantized:
+            new["k_scale"] = jax.lax.dynamic_update_slice(
+                self.k_scale, ks[:, None], (0, slot, 0, 0))
+            new["v_scale"] = jax.lax.dynamic_update_slice(
+                self.v_scale, vs[:, None], (0, slot, 0, 0))
+        return dataclasses.replace(self, **new)
+
+
+def cache_bytes_per_slot(num_layers: int, num_heads: int, max_len: int,
+                         head_dim: int, dtype=jnp.bfloat16) -> int:
+    """HBM bytes one sequence slot pins for its whole lifetime — the unit
+    of the capacity math in :func:`apex_tpu.serving.engine.suggest_max_seqs`
+    (k + v, plus the fp32 scales when int8)."""
+    per_pos = 2 * num_layers * num_heads * head_dim * jnp.dtype(dtype).itemsize
+    if jnp.dtype(dtype) == jnp.int8:
+        per_pos += 2 * num_layers * num_heads * 4
+    return per_pos * max_len

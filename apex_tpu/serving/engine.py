@@ -1,0 +1,237 @@
+"""AOT-compiled prefill/decode steps with a donated KV cache.
+
+The engine owns the cache and the two compiled programs a serving
+process runs forever:
+
+- **prefill**: one request's padded prompt ``(1, prefill_len)`` through
+  the ordinary causal forward (the training flash path), K/V written
+  into one cache slot, the first output token sampled from the logits at
+  the prompt's true last position;
+- **decode**: ONE token for EVERY slot ``(max_seqs, 1)`` through the
+  decode attention kernel, K/V appended at each slot's cursor, next
+  tokens sampled.
+
+Both are ``jax.jit(..., donate_argnums=<cache>)`` and compiled ONCE at
+construction (``.trace().lower().compile()`` — the bench/test AOT
+convention), which buys the two serving-latency properties the tests
+pin down:
+
+- **zero allocation**: the cache buffers are donated and every write is
+  a fixed-position dynamic_update_slice, so XLA aliases them in place
+  (``input_output_alias`` asserted over every cache leaf in
+  ``tests/test_serving.py``) — a decode step never copies the cache;
+- **zero recompilation**: every per-request quantity is an array
+  argument (tokens, temperatures, cursors-in-cache) and every
+  shape-changing knob is fixed at construction (``max_seqs``,
+  ``prefill_len``, ``top_k``), so admission/retirement never retraces —
+  the compile-storm counters (PR 1) are asserted flat across steps.
+
+Capacity: :meth:`ServingEngine.suggest_max_seqs` turns the compiled
+decode step's static memory plan (``observability/costs.memory_budget``)
+into "how many concurrent sequences fit this chip's HBM" — the
+ROADMAP's cache-capacity accounting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.observability.costs import memory_budget
+from apex_tpu.serving.cache import KVCache, cache_bytes_per_slot
+from apex_tpu.serving.sampling import sample_tokens
+
+__all__ = ["ServingEngine"]
+
+
+class ServingEngine:
+    """See module docstring.
+
+    Args:
+      model: a :class:`~apex_tpu.models.gpt.GPTModel` (tp=1, no SP).
+      params: its :meth:`init` pytree.
+      max_seqs: concurrent sequence slots (the decode batch width).
+      max_len: per-slot cache capacity in tokens (<= the model's
+        ``max_position_embeddings``).
+      prefill_len: the fixed prompt window; prompts are right-padded to
+        it (longer prompts are rejected — one bucket keeps this PR's
+        program count at two).
+      cache_dtype: ``jnp.bfloat16`` (default) or ``jnp.int8`` (quantized
+        cache with per-(position, head) scales).
+      top_k: static top-k sampling cutoff (0 = full vocab).
+    """
+
+    def __init__(self, model, params, *, max_seqs: int, max_len: int,
+                 prefill_len: int, cache_dtype=jnp.bfloat16,
+                 top_k: int = 0, rng_seed: int = 0):
+        model._require_cacheable()
+        cfg = model.cfg
+        if max_len > cfg.max_position_embeddings:
+            raise ValueError(
+                f"max_len {max_len} exceeds max_position_embeddings "
+                f"{cfg.max_position_embeddings}")
+        if prefill_len > max_len:
+            raise ValueError(f"prefill_len {prefill_len} exceeds max_len "
+                             f"{max_len}")
+        self.model = model
+        self.params = params
+        self.max_seqs = int(max_seqs)
+        self.max_len = int(max_len)
+        self.prefill_len = int(prefill_len)
+        self.top_k = int(top_k)
+        self.cache = KVCache.create(
+            cfg.num_layers, max_seqs, cfg.num_attention_heads, max_len,
+            cfg.head_dim, dtype=cache_dtype)
+
+        def prefill_step(params, cache, tokens, slot, true_len,
+                         temperature, rng):
+            with jax.named_scope("serve_prefill"):
+                # last_logit_only: the admission samples exactly one row
+                # of the head, so only that row is projected
+                logits, cache = model.forward(params, tokens,
+                                              kv_cache=cache, slot=slot,
+                                              prompt_len=true_len,
+                                              last_logit_only=True)
+                tok = sample_tokens(logits[0], rng, temperature[None],
+                                    self.top_k)[0]
+            return cache, tok
+
+        def decode_step(params, cache, tokens, temperature, active, rng):
+            with jax.named_scope("serve_decode"):
+                logits, cache = model.forward(params, tokens[:, None],
+                                              kv_cache=cache,
+                                              active=active)
+                toks = sample_tokens(logits, rng, temperature, self.top_k)
+            return cache, toks
+
+        key = jax.random.PRNGKey(rng_seed)
+        self._key, _ = jax.random.split(key)  # also warms split's compile
+        S = self.max_seqs
+        ex_tokens = jnp.zeros((1, self.prefill_len), jnp.int32)
+        ex_scalar = jnp.zeros((), jnp.int32)
+        ex_temp = jnp.zeros((), jnp.float32)
+        self.prefill_traced = jax.jit(
+            prefill_step, donate_argnums=(1,)).trace(
+                params, self.cache, ex_tokens, ex_scalar, ex_scalar,
+                ex_temp, self._key)
+        self.prefill_compiled = self.prefill_traced.lower().compile()
+        self.decode_traced = jax.jit(
+            decode_step, donate_argnums=(1,)).trace(
+                params, self.cache, jnp.zeros((S,), jnp.int32),
+                jnp.zeros((S,), jnp.float32), jnp.ones((S,), jnp.bool_),
+                self._key)
+        self.decode_compiled = self.decode_traced.lower().compile()
+
+        def release_step(cache, slot):
+            # zero one slot's cursor so a freed slot stops paying
+            # attention over its dead prefix on every later decode step
+            lengths = jax.lax.dynamic_update_slice(
+                cache.lengths, jnp.zeros((1,), jnp.int32), (slot,))
+            return dataclasses.replace(cache, lengths=lengths)
+
+        self.release_compiled = jax.jit(
+            release_step, donate_argnums=(0,)).trace(
+                self.cache, ex_scalar).lower().compile()
+
+    # -- stepping -----------------------------------------------------------
+
+    def _next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def pad_prompt(self, prompt: Sequence[int]) -> jnp.ndarray:
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.prefill_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the prefill window "
+                f"{self.prefill_len} (pick a larger prefill_len at "
+                "engine construction)")
+        padded = np.zeros((1, self.prefill_len), np.int32)
+        padded[0, : len(prompt)] = np.asarray(prompt, np.int32)
+        return jnp.asarray(padded)
+
+    def prefill(self, prompt: Sequence[int], slot: int,
+                temperature: float = 0.0) -> int:
+        """Admit ``prompt`` into ``slot`` and return the first sampled
+        token (a host int). Consumes and replaces the donated cache."""
+        if not 0 <= int(slot) < self.max_seqs:
+            # an out-of-range slot would CLAMP inside the compiled
+            # dynamic_update_slice and silently clobber the last valid
+            # slot's in-flight sequence
+            raise ValueError(f"slot {slot} out of range "
+                             f"[0, {self.max_seqs})")
+        self.cache, tok = self.prefill_compiled(
+            self.params, self.cache, self.pad_prompt(prompt),
+            jnp.asarray(slot, jnp.int32),
+            jnp.asarray(len(prompt), jnp.int32),
+            jnp.asarray(temperature, jnp.float32), self._next_key())
+        return int(tok)
+
+    def decode(self, tokens: np.ndarray, temperatures: np.ndarray,
+               active: Optional[np.ndarray] = None) -> np.ndarray:
+        """One decode step for every slot: ``tokens (max_seqs,)`` are the
+        last emitted token per slot (anything for free slots), returns
+        the next token per slot. ``active`` (``(max_seqs,)`` bool,
+        default all): slots outside it keep a frozen cursor — free slots
+        never grow an attention prefix. Consumes and replaces the
+        donated cache."""
+        if active is None:
+            active = np.ones(self.max_seqs, np.bool_)
+        self.cache, toks = self.decode_compiled(
+            self.params, self.cache,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(temperatures, jnp.float32),
+            jnp.asarray(active, jnp.bool_), self._next_key())
+        return np.asarray(toks)
+
+    def release_slot(self, slot: int) -> None:
+        """Zero ``slot``'s write cursor (AOT-compiled, donated like the
+        steps). Call when a sequence retires: the decode kernel skips
+        the compute of blocks past the cursor (and the XLA fallback
+        skips nothing but masks), so an idle slot left at a deep cursor
+        would keep paying prefix attention math on every step until
+        reused — and the cursor is also the capacity/accounting truth
+        the next admission relies on."""
+        if not 0 <= int(slot) < self.max_seqs:
+            raise ValueError(f"slot {slot} out of range "
+                             f"[0, {self.max_seqs})")
+        self.cache = self.release_compiled(self.cache,
+                                           jnp.asarray(slot, jnp.int32))
+
+    # -- capacity -----------------------------------------------------------
+
+    def bytes_per_slot(self) -> int:
+        cfg = self.model.cfg
+        return cache_bytes_per_slot(cfg.num_layers,
+                                    cfg.num_attention_heads, self.max_len,
+                                    cfg.head_dim, self.cache.k.dtype)
+
+    def overhead_bytes(self) -> Optional[int]:
+        """Non-cache HBM the compiled decode step pins (params, logits,
+        temporaries), from the executable's static memory plan — None
+        when the backend reports no analysis."""
+        budget = memory_budget(self.decode_compiled)
+        if budget is None:
+            return None
+        return max(0, int(budget["peak_hbm_bytes"]) - self.cache.nbytes())
+
+    def suggest_max_seqs(self, hbm_bytes: int,
+                         reserve_fraction: float = 0.1) -> int:
+        """Max concurrent sequence slots that fit ``hbm_bytes``: the
+        compiled step's non-cache footprint (measured, not guessed) is
+        subtracted, a ``reserve_fraction`` safety margin held back, and
+        the rest divided by the per-slot cache bytes. Falls back to the
+        raw params size as the overhead estimate when the backend
+        exposes no memory analysis."""
+        overhead = self.overhead_bytes()
+        if overhead is None:
+            overhead = sum(
+                leaf.size * leaf.dtype.itemsize
+                for leaf in jax.tree_util.tree_leaves(self.params))
+        avail = int(hbm_bytes * (1.0 - reserve_fraction)) - overhead
+        return max(0, avail // self.bytes_per_slot())

@@ -1,0 +1,43 @@
+"""Fixed-shape token sampling for the AOT decode step.
+
+Everything here traces into the compiled decode program, so every knob
+that can vary per request rides as an ARRAY argument (per-slot
+temperature), and every knob that changes the program shape is a static
+compile-time constant (``top_k``). Greedy decoding is temperature 0 —
+selected per slot with a ``where``, not a branch — so one compiled
+program serves any mix of greedy and stochastic requests in the same
+batch, and admitting a request never recompiles.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sample_tokens"]
+
+# temperatures at or below this sample greedily (exact argmax, not a
+# division by epsilon — the where keeps logits/0 out of the graph)
+_GREEDY_EPS = 1e-6
+
+
+def sample_tokens(logits: jnp.ndarray, rng: jax.Array,
+                  temperature: jnp.ndarray, top_k: int = 0) -> jnp.ndarray:
+    """One next-token per row of ``logits (S, vocab)``.
+
+    ``temperature (S,)``: <= 0 means greedy for that slot; otherwise the
+    logits are temperature-scaled and sampled categorically.
+    ``top_k`` (static): when > 0, mask everything below the k-th logit
+    before sampling (``top_k=1`` is exactly greedy). Returns ``(S,)``
+    int32.
+    """
+    logits = logits.astype(jnp.float32)
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temperature = jnp.asarray(temperature, jnp.float32)
+    safe_t = jnp.maximum(temperature, _GREEDY_EPS)[:, None]
+    sampled = jax.random.categorical(rng, logits / safe_t,
+                                     axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= _GREEDY_EPS, greedy, sampled)

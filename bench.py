@@ -56,6 +56,16 @@ Other configs:
              baseline (``gpt_sp_overlap_tokens_per_sec``; needs >= 2
              devices, emits a skip line otherwise — docs/PERF.md
              "Dependent-collective overlap");
+  decode   — serving fast path: KV-cached autoregressive decode through
+             the AOT ``ServingEngine`` (Pallas decode kernel, donated
+             cache, fixed-shape sampling). Two legs:
+             ``gpt_decode_tok_per_sec_b1`` (one active slot in a
+             max_seqs=1 program — per-token latency) and
+             ``gpt_decode_tok_per_sec_sat`` (every slot of the
+             saturating grid active — per-chip throughput), each with
+             HBM accounting and a prefill-vs-decode pyprof split;
+             ``vs_baseline`` is measured over the HBM roofline
+             (docs/SERVING.md "Reading bench_gpt_decode");
   fast     — the compound ``fastpath`` preset (tp_comm_overlap +
              bucketed DP + ZeRO-1 backward-interleaved apply +
              selective remat + donation) through the hybrid trainer vs
@@ -844,6 +854,115 @@ def bench_dp_accumulate_overlap(iters=10, warmup=2, K=4, layers=8,
           std_ms=round(float(np.std(times) * 1e3), 3))
 
 
+def bench_gpt_decode(iters=40, warmup=5, prefill_iters=5, max_len=1024,
+                     prefill_len=128, sat_slots=8, hidden=768, layers=12,
+                     heads=12, vocab=32768):
+    """Serving decode family (docs/SERVING.md): the GPT-small shape
+    through the AOT ``ServingEngine``, timing the compiled decode step
+    with its donated cache threaded call-to-call (the autoregressive
+    loop itself: sampled tokens feed back as the next step's input).
+
+    - ``gpt_decode_tok_per_sec_b1``: a ``max_seqs=1`` program, one
+      active sequence — the latency leg; 1/value is the per-token
+      interval a single user sees.
+    - ``gpt_decode_tok_per_sec_sat``: a ``max_seqs=sat_slots`` program
+      with every slot active — the throughput leg continuous batching
+      sustains at saturation.
+
+    ``vs_baseline`` is measured/roofline against the HBM-bound bound
+    (params read once per step + each active slot's LIVE cache stripe at
+    the measured mean length, over the chip's ``DeviceSpec`` bandwidth)
+    — necessarily < 1; the gap is the decode overhead. Known v1
+    contributor: the kernel's pipelined block fetches are max_len-shaped
+    (compute past the cursor is skipped, fetches are not), so expect the
+    gap to track mean_len/max_len until the bounded-grid variant lands
+    (docs/SERVING.md). Each line carries
+    ``temp_bytes``/``peak_hbm_bytes`` (decode program), the decode-step
+    pyprof attribution, and a ``prefill_step_ms`` + prefill attribution
+    so the prefill/decode split rides the bench history. CPU numbers are
+    structural; read real tokens/sec off a TPU run."""
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.observability.costs import device_spec
+    from apex_tpu.serving import ServingEngine
+
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                    num_layers=layers, num_attention_heads=heads,
+                    max_position_embeddings=max_len,
+                    compute_dtype=jnp.bfloat16)
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    param_bytes = sum(l.size * l.dtype.itemsize
+                      for l in jax.tree_util.tree_leaves(params))
+    prompt = np.random.RandomState(0).randint(
+        1, vocab, size=prefill_len).tolist()
+
+    def measure(slots):
+        eng = ServingEngine(model, params, max_seqs=slots,
+                            max_len=max_len, prefill_len=prefill_len)
+        key = eng._next_key()
+        temps = jnp.zeros((slots,), jnp.float32)
+
+        # prefill leg: the compiled prefill threaded the _timeit way
+        # (slot 0 overwritten each call — timing, not generation)
+        ptok = eng.pad_prompt(prompt)
+        zero = jnp.asarray(0, jnp.int32)
+        plen = jnp.asarray(prefill_len, jnp.int32)
+
+        def pwrap(cache, tok):
+            cache, tok = eng.prefill_compiled(
+                params, cache, ptok, zero, plen, jnp.float32(0.0), key)
+            return cache, tok
+        ptimes = _timeit(pwrap, (eng.cache, jnp.asarray(0, jnp.int32)),
+                         prefill_iters, 1)
+        prefill_ms = float(np.mean(ptimes) * 1e3)
+        # the timing loop consumed the engine's donated cache outside its
+        # bookkeeping — give it a fresh one, then fill every slot so the
+        # decode leg runs fully active
+        from apex_tpu.serving import KVCache
+        eng.cache = KVCache.create(layers, slots, heads, max_len,
+                                   cfg.head_dim, dtype=jnp.bfloat16)
+        for s in range(slots):
+            eng.prefill(prompt, slot=s)
+
+        all_active = jnp.ones((slots,), jnp.bool_)
+
+        def dwrap(cache, toks):
+            cache, toks = eng.decode_compiled(params, cache, toks, temps,
+                                              all_active, key)
+            return cache, toks
+        times = _timeit(dwrap, (eng.cache, jnp.zeros((slots,),
+                                                     jnp.int32)),
+                        iters, warmup)
+        step_ms = float(np.mean(times) * 1e3)
+        tok_per_sec = slots / float(np.mean(times))
+
+        # HBM roofline: params once per step + each slot's K+V stripe at
+        # the mean decoded length (two dtype-width bytes per element)
+        mean_len = prefill_len + (warmup + iters) / 2.0
+        stripe = (2 * layers * heads * mean_len * cfg.head_dim
+                  * jnp.dtype(jnp.bfloat16).itemsize)
+        spec = device_spec()
+        step_bytes = param_bytes + slots * stripe
+        roofline = slots / (step_bytes / (spec.hbm_gbps * 1e9))
+        extras = dict(_mem_extra(eng.decode_compiled))
+        extras.update(_attrib_extra(eng.decode_traced, step_ms))
+        extras.update({f"prefill_{k}": v for k, v in _attrib_extra(
+            eng.prefill_traced, prefill_ms).items()})
+        return (tok_per_sec, roofline, step_ms,
+                float(np.std(times) * 1e3), prefill_ms, extras)
+
+    for metric, slots in (("gpt_decode_tok_per_sec_b1", 1),
+                          ("gpt_decode_tok_per_sec_sat", sat_slots)):
+        tps, roof, step_ms, std_ms, prefill_ms, extras = measure(slots)
+        _emit(metric, tps, "tokens/sec", tps / roof,
+              anchor="hbm_roofline_this_chip",
+              roofline_tok_per_sec=round(roof, 2),
+              step_ms=round(step_ms, 3), std_ms=round(std_ms, 3),
+              prefill_step_ms=round(prefill_ms, 3),
+              slots=slots, max_len=max_len, prefill_len=prefill_len,
+              iters=iters, **extras)
+
+
 def bench_flash_long(seq=4096, b=8, h=12, d=64):
     """Long-context evidence: flash (auto 512-blocks) vs XLA attention
     fwd+bwd at seq 4096 — the regime the reference cannot reach at all
@@ -896,14 +1015,15 @@ def main():
         t0 = time.perf_counter()
         # the multi-compile configs run LAST, newest first to be starved:
         # sp_ovl (two GPT TP=2 compiles) after the longer-tracked configs
-        # above it, remat (FOUR GPT-small train-step compiles) next, and
-        # gpt_fast (two full hybrid-trainer compiles, the newest leg)
-        # dead last so a tight budget drops the newest metrics, never
-        # the established baseline rows
+        # above it, remat (FOUR GPT-small train-step compiles) next,
+        # gpt_fast (two full hybrid-trainer compiles) after that, and
+        # gpt_decode (two serving engines = four AOT compiles, the
+        # newest leg) dead last so a tight budget drops the newest
+        # metrics, never the established baseline rows
         for fn in (bench_layernorm, bench_optimizer, bench_gpt,
                    bench_flash_long, bench_dp_accumulate_overlap,
                    bench_gpt_sp_overlap, bench_gpt_remat,
-                   bench_gpt_fast):
+                   bench_gpt_fast, bench_gpt_decode):
             if time.perf_counter() - t0 > budget_s:
                 _emit(fn.__name__, -1.0, "skipped", None,
                       error="config budget exhausted; headline protected")

@@ -54,6 +54,11 @@ ANNOTATIONS = {
         "apex_tpu/transformer/tensor_parallel/layers.py"],
     "tp_row_linear": [
         "apex_tpu/transformer/tensor_parallel/layers.py"],
+    # serving fast path: the decode kernel plus the two AOT step bodies,
+    # so pyprof attributes prefill vs decode (docs/SERVING.md)
+    "decode_attention": ["apex_tpu/ops/flash_attention.py"],
+    "serve_prefill": ["apex_tpu/serving/engine.py"],
+    "serve_decode": ["apex_tpu/serving/engine.py"],
 }
 
 

@@ -38,7 +38,7 @@ DOC = os.path.join("docs", "OBSERVABILITY.md")
 # metric families under the documentation contract; names outside these
 # prefixes (host registry internals, ad-hoc example metrics) are exempt
 PREFIXES = ("health/", "tp/", "amp/", "ddp/", "pipeline/", "optim/",
-            "zero/", "mem/", "perf/", "ckpt/", "resume/")
+            "zero/", "mem/", "perf/", "ckpt/", "resume/", "serve/")
 
 # callees whose literal first argument is a metric name: in-graph
 # ``ingraph.record(...)`` and the host-registry accessors — ``gauge``

@@ -66,6 +66,23 @@ def test_gpt_pretrain_elastic_checkpoint_and_resume(tmp_path):
     assert latest_step(str(tmp_path)) == 3
 
 
+def test_gpt_serve_runs():
+    """The serving demo: every request completes through the continuous
+    batcher and the serve/* surface is populated (docs/SERVING.md)."""
+    import gpt_serve
+    payload = gpt_serve.main(["--requests", "4", "--max-new-tokens", "4"])
+    results = payload["completions"]
+    assert sorted(results) == list(range(4))
+    for i, c in sorted(results.items()):
+        assert len(c.tokens) == 1 + (4 * (i + 1)) // 2
+        assert c.finish_reason == "length"
+    m = payload["metrics"]
+    assert m["serve/admitted"] == 4.0 and m["serve/retired"] == 4.0
+    assert m["serve/generated_tokens"] == sum(
+        1 + (4 * (i + 1)) // 2 for i in range(4))
+    assert m["serve/tokens_per_sec"] > 0.0
+
+
 def test_dcgan_amp_runs():
     import dcgan_amp
     errD, errG = dcgan_amp.main(["--steps", "3", "--batch", "8"])

@@ -379,3 +379,50 @@ def test_segment_ids_validation():
     ref = flash_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+# -- decode shapes (sq=1 vs a cached sk) — the serving kernel family's
+#    entry points into this module; the cache-streaming kernel itself is
+#    covered in tests/test_serving.py
+
+
+def test_supports_flash_decode_shapes():
+    """sq == 1 is a first-class shape: only the key-side tiling gates
+    (the historical gate silently assumed sq == sk callers)."""
+    assert supports_flash(1, 1024, 64, 1, 128)
+    assert supports_flash(1, 256, 64, 1, 256)
+    assert not supports_flash(1, 200, 64, 1, 128)   # sk misaligned
+    assert not supports_flash(1, 256, 63, 1, 128)   # d misaligned
+    assert not supports_flash(1, 256, 64, 8, 128)   # q tile must be 1
+    # the training gate is unchanged
+    assert supports_flash(256, 256, 64, 128, 128)
+    assert not supports_flash(200, 256, 64, 128, 128)
+
+
+def test_flash_sq1_pallas_matches_reference():
+    """The generic flash entry point takes the Pallas path at sq=1
+    (block_q=1, one padded sublane tile) and matches the reference —
+    causal at sq=1 means 'attend to everything cached'."""
+    q, k, v = _qkv(sq=1, sk=256, seed=11)
+    out = flash_attention(q, k, v, causal=True, use_pallas=True)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # auto path selects Pallas for the aligned decode shape
+    auto = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(auto), np.asarray(out),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_mha_reference_kv_length_oracle():
+    """The kv_length oracle path: masks exactly like slicing the cache at
+    the cursor, and zeroes empty rows."""
+    q, k, v = _qkv(b=3, h=2, sq=1, sk=64, seed=12)
+    lengths = jnp.asarray([0, 5, 64], jnp.int32)
+    out = mha_reference(q, k, v, kv_length=lengths)
+    assert np.all(np.asarray(out[0]) == 0.0)
+    for i, L in ((1, 5), (2, 64)):
+        ref = mha_reference(q[i:i + 1], k[i:i + 1, :, :L],
+                            v[i:i + 1, :, :L])
+        np.testing.assert_allclose(np.asarray(out[i]), np.asarray(ref[0]),
+                                   rtol=2e-6, atol=2e-6)

@@ -922,8 +922,9 @@ class TestCheckAnnotations:
         assert proc.returncode == 0, proc.stdout + proc.stderr
         # the table doubles as the pyprof region vocabulary (round 6):
         # 4 original annotations + bucketed allreduce + optimizer_step +
-        # 8 model phases + 2 tp layers
-        assert proc.stdout.count("ok ") == 16
+        # 8 model phases + 2 tp layers + 3 serving regions (decode
+        # kernel + the prefill/decode step bodies, round 10)
+        assert proc.stdout.count("ok ") == 19
 
     def test_detects_missing_annotation(self, tmp_path):
         import importlib.util
@@ -1022,7 +1023,8 @@ class TestCheckMetricsDoc:
         assert proc.returncode == 0, proc.stdout + proc.stderr
         # the known families all show up as checked
         for family in ("health/", "amp/", "ddp/", "pipeline/", "optim/",
-                       "tp/", "zero/", "perf/", "ckpt/", "resume/"):
+                       "tp/", "zero/", "perf/", "ckpt/", "resume/",
+                       "serve/"):
             assert family in proc.stdout, family
 
     def _mod(self):
@@ -1094,6 +1096,31 @@ class TestCheckMetricsDoc:
         (docs / "OBSERVABILITY.md").write_text(
             "| `ckpt/rogue_bytes` | `ckpt/rogue_ms` | "
             "`resume/rogue_count` |\n")
+        ok, lines = mod.check(repo=str(tmp_path))
+        assert ok, "\n".join(lines)
+
+    def test_detects_undocumented_serve_metric(self, tmp_path):
+        """The serving scheduler's serve/* family (counters + gauges on
+        the host registry) is under the doc contract (round 10)."""
+        mod = self._mod()
+        assert "serve/" in mod.PREFIXES
+        pkg = tmp_path / "apex_tpu" / "serving"
+        pkg.mkdir(parents=True)
+        (pkg / "m.py").write_text(
+            "def f(reg, x):\n"
+            "    reg.counter('serve/rogue_admitted').inc()\n"
+            "    reg.gauge('serve/rogue_depth').set(x)\n")
+        docs = tmp_path / "docs"
+        docs.mkdir()
+        (docs / "OBSERVABILITY.md").write_text("| nothing documented |\n")
+        ok, lines = mod.check(repo=str(tmp_path))
+        assert not ok
+        undoc = [l for l in lines if l.startswith("UNDOC")]
+        assert len(undoc) == 2
+        for name in ("serve/rogue_admitted", "serve/rogue_depth"):
+            assert any(name in l for l in undoc), name
+        (docs / "OBSERVABILITY.md").write_text(
+            "| `serve/rogue_admitted` | `serve/rogue_depth` |\n")
         ok, lines = mod.check(repo=str(tmp_path))
         assert ok, "\n".join(lines)
 

@@ -1,0 +1,591 @@
+"""Serving fast path: decode kernel parity, KV-cached prefill/decode vs
+the one-shot forward, AOT donation + zero-recompile contracts, and the
+continuous slot batcher (docs/SERVING.md)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.models import GPTConfig, GPTModel
+from apex_tpu.ops.flash_attention import decode_attention, mha_reference
+from apex_tpu.serving import (KVCache, Request, ServingEngine,
+                              SlotScheduler, cache_bytes_per_slot,
+                              sample_tokens)
+from apex_tpu.observability.registry import MetricsRegistry
+
+
+def _quantize_ref(x):
+    """Host-side mirror of the cache's symmetric per-(position, head)
+    int8 quantization."""
+    scale = np.maximum(np.abs(x).max(-1) / 127.0, 1e-8)
+    q = np.clip(np.round(x / scale[..., None]), -127, 127).astype(np.int8)
+    return q, scale.astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# decode kernel vs the mha_reference cache oracle
+# ---------------------------------------------------------------------------
+
+class TestDecodeKernel:
+    B, H, T, D = 4, 4, 256, 32
+    LENGTHS = [0, 1, 100, 256]  # empty, single, partial, full
+
+    def _rand(self, rng, shape, dtype):
+        return jnp.asarray(rng.randn(*shape), dtype)
+
+    @pytest.mark.parametrize("dtype,tol", [(jnp.float32, 2e-6),
+                                           (jnp.bfloat16, 2e-2)])
+    def test_parity_vs_cache_oracle(self, dtype, tol):
+        rng = np.random.RandomState(0)
+        q = self._rand(rng, (self.B, self.H, self.D), dtype)
+        k = self._rand(rng, (self.B, self.H, self.T, self.D), dtype)
+        v = self._rand(rng, (self.B, self.H, self.T, self.D), dtype)
+        lengths = jnp.asarray(self.LENGTHS, jnp.int32)
+        out = decode_attention(q, k, v, lengths)
+        ref = mha_reference(q[:, :, None], k, v, kv_length=lengths)[:, :, 0]
+        np.testing.assert_allclose(out.astype(np.float32),
+                                   ref.astype(np.float32), atol=tol)
+        # the empty row is exactly zero on both paths
+        assert np.all(np.asarray(out[0]) == 0.0)
+
+    def test_current_token_merge_matches_in_cache_oracle(self):
+        """decode_attention(k_new=...) over an L-length prefix must equal
+        the oracle over an (L+1)-length cache with the token written at
+        the cursor — the exactness the write-after-read decode step
+        relies on."""
+        rng = np.random.RandomState(1)
+        q = self._rand(rng, (self.B, self.H, self.D), jnp.float32)
+        k = self._rand(rng, (self.B, self.H, self.T, self.D), jnp.float32)
+        v = self._rand(rng, (self.B, self.H, self.T, self.D), jnp.float32)
+        kn = self._rand(rng, (self.B, self.H, self.D), jnp.float32)
+        vn = self._rand(rng, (self.B, self.H, self.D), jnp.float32)
+        prefix = [0, 1, 100, 255]
+        k2, v2 = k, v
+        for i, L in enumerate(prefix):
+            k2 = k2.at[i, :, L].set(kn[i])
+            v2 = v2.at[i, :, L].set(vn[i])
+        out = decode_attention(q, k, v, jnp.asarray(prefix), k_new=kn,
+                               v_new=vn)
+        ref = mha_reference(q[:, :, None], k2, v2,
+                            kv_length=jnp.asarray(prefix) + 1)[:, :, 0]
+        np.testing.assert_allclose(out, ref, atol=2e-6)
+        # empty prefix == softmax over one position == exactly v_new
+        np.testing.assert_array_equal(np.asarray(out[0]),
+                                      np.asarray(vn[0]))
+
+    def test_int8_cache_parity(self):
+        rng = np.random.RandomState(2)
+        q = self._rand(rng, (self.B, self.H, self.D), jnp.float32)
+        kf = rng.randn(self.B, self.H, self.T, self.D).astype(np.float32)
+        vf = rng.randn(self.B, self.H, self.T, self.D).astype(np.float32)
+        ki, ks = _quantize_ref(kf)
+        vi, vs = _quantize_ref(vf)
+        lengths = jnp.asarray([3, 50, 200, 256], jnp.int32)
+        out = decode_attention(q, jnp.asarray(ki), jnp.asarray(vi),
+                               lengths, k_scale=jnp.asarray(ks),
+                               v_scale=jnp.asarray(vs))
+        # oracle over the DEQUANTIZED cache: the kernel's only error
+        # budget is fp roundoff, not quantization (same int8 values in)
+        ref = mha_reference(q[:, :, None],
+                            jnp.asarray(ki.astype(np.float32)
+                                        * ks[..., None]),
+                            jnp.asarray(vi.astype(np.float32)
+                                        * vs[..., None]),
+                            kv_length=lengths)[:, :, 0]
+        np.testing.assert_allclose(out, ref, atol=2e-6)
+        # and vs the unquantized truth the int8 error stays bounded
+        full = mha_reference(q[:, :, None], jnp.asarray(kf),
+                             jnp.asarray(vf), kv_length=lengths)[:, :, 0]
+        assert np.max(np.abs(out - full)) < 0.05
+
+    def test_pallas_and_fallback_agree(self):
+        rng = np.random.RandomState(3)
+        q = self._rand(rng, (self.B, self.H, self.D), jnp.float32)
+        k = self._rand(rng, (self.B, self.H, self.T, self.D), jnp.float32)
+        v = self._rand(rng, (self.B, self.H, self.T, self.D), jnp.float32)
+        kn = self._rand(rng, (self.B, self.H, self.D), jnp.float32)
+        vn = self._rand(rng, (self.B, self.H, self.D), jnp.float32)
+        lengths = jnp.asarray(self.LENGTHS, jnp.int32)
+        a = decode_attention(q, k, v, lengths, k_new=kn, v_new=vn,
+                             use_pallas=True)
+        b = decode_attention(q, k, v, lengths, k_new=kn, v_new=vn,
+                             use_pallas=False)
+        np.testing.assert_allclose(a, b, atol=2e-6)
+
+    def test_int8_requires_scales(self):
+        z8 = jnp.zeros((1, 1, 128, 8), jnp.int8)
+        with pytest.raises(ValueError, match="k_scale"):
+            decode_attention(jnp.zeros((1, 1, 8)), z8, z8,
+                             jnp.zeros(1, jnp.int32))
+
+    def test_forced_pallas_on_misaligned_cache_refused(self):
+        """use_pallas=True on a misaligned max_len would silently drop
+        the T % block_k tail (or never write the output at
+        T < block_k) — it must raise, not decode garbage; the auto path
+        falls back and stays correct."""
+        rng = np.random.RandomState(5)
+        for T in (192, 64):  # tail-dropping and empty-grid cases
+            q = jnp.asarray(rng.randn(2, 2, 32), jnp.float32)
+            k = jnp.asarray(rng.randn(2, 2, T, 32), jnp.float32)
+            v = jnp.asarray(rng.randn(2, 2, T, 32), jnp.float32)
+            lengths = jnp.asarray([T, T // 2], jnp.int32)
+            with pytest.raises(ValueError, match="tile-aligned"):
+                decode_attention(q, k, v, lengths, use_pallas=True)
+            auto = decode_attention(q, k, v, lengths)
+            ref = mha_reference(q[:, :, None], k, v,
+                                kv_length=lengths)[:, :, 0]
+            np.testing.assert_allclose(auto, ref, atol=2e-6)
+
+    def test_kv_length_oracle_masks_garbage(self):
+        """mha_reference's kv_length path must be insensitive to cache
+        content past the cursor — the property that makes it a valid
+        oracle for a preallocated cache."""
+        rng = np.random.RandomState(4)
+        q = jnp.asarray(rng.randn(2, 2, 1, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(2, 2, 32, 8), jnp.float32)
+        v = jnp.asarray(rng.randn(2, 2, 32, 8), jnp.float32)
+        lengths = jnp.asarray([5, 20])
+        ref = mha_reference(q, k, v, kv_length=lengths)
+        trash = mha_reference(
+            q, k.at[0, :, 5:].set(1e4).at[1, :, 20:].set(-1e4),
+            v.at[0, :, 5:].set(7.0), kv_length=lengths)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(trash))
+
+
+# ---------------------------------------------------------------------------
+# KV cache pytree
+# ---------------------------------------------------------------------------
+
+class TestKVCache:
+    def test_append_and_write_prompt(self):
+        cache = KVCache.create(2, 3, 2, 8, 4, dtype=jnp.float32)
+        k_p = jnp.ones((2, 2, 5, 4))
+        cache = cache.write_prompt(k_p, 2 * k_p, slot=1, true_len=3)
+        assert int(cache.lengths[1]) == 3 and int(cache.lengths[0]) == 0
+        k_n = jnp.full((2, 3, 2, 4), 9.0)
+        cache = cache.append(k_n, k_n)
+        # slot 1 appended at its cursor (3); slot 0 at 0
+        assert float(cache.k[0, 1, 0, 3, 0]) == 9.0
+        assert float(cache.k[0, 1, 0, 2, 0]) == 1.0   # prompt intact
+        assert float(cache.k[0, 0, 0, 0, 0]) == 9.0
+        assert cache.lengths.tolist() == [1, 4, 1]
+
+    def test_append_saturates_at_max_len(self):
+        cache = KVCache.create(1, 1, 1, 2, 4, dtype=jnp.float32)
+        u = jnp.ones((1, 1, 1, 4))
+        for _ in range(4):
+            cache = cache.append(u, u)
+        assert int(cache.lengths[0]) == 2  # clamped, no OOB write
+
+    def test_int8_roundtrip(self):
+        cache = KVCache.create(1, 1, 2, 4, 8, dtype=jnp.int8)
+        assert cache.quantized
+        x = jnp.asarray(np.random.RandomState(0).randn(1, 1, 2, 8),
+                        jnp.float32)
+        cache = cache.append(x, x)
+        deq = (cache.k[0, 0, :, 0].astype(jnp.float32)
+               * cache.k_scale[0, 0, :, 0, None])
+        np.testing.assert_allclose(deq, x[0, 0], atol=float(
+            jnp.max(jnp.abs(x)) / 127.0) + 1e-6)
+        # pytree roundtrip preserves the quantized layout
+        leaves, treedef = jax.tree_util.tree_flatten(cache)
+        assert len(leaves) == 5
+        assert jax.tree_util.tree_unflatten(treedef, leaves).quantized
+
+    def test_bytes_per_slot(self):
+        bf16 = cache_bytes_per_slot(12, 12, 1024, 64, jnp.bfloat16)
+        assert bf16 == 2 * 12 * 12 * 64 * 2 * 1024
+        i8 = cache_bytes_per_slot(12, 12, 1024, 64, jnp.int8)
+        assert i8 == (2 * 12 * 12 * 64 + 2 * 12 * 12 * 4) * 1024
+        cache = KVCache.create(12, 3, 12, 1024, 64, dtype=jnp.int8)
+        assert cache.nbytes() == 3 * i8 + 3 * 4  # + the (S,) cursor
+
+
+# ---------------------------------------------------------------------------
+# prefill + N decode steps vs the one-shot causal forward
+# ---------------------------------------------------------------------------
+
+def _tiny_model(compute_dtype):
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_attention_heads=4, max_position_embeddings=64,
+                    compute_dtype=compute_dtype)
+    model = GPTModel(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+class TestPrefillDecodeParity:
+    @pytest.mark.parametrize("compute,cache_dtype,tol", [
+        # fp32 end to end: the decode path agrees with the one-shot
+        # forward to fp32 roundoff (the reduction ORDER differs — block
+        # streaming + two-way merge vs one softmax — so bitwise identity
+        # is not the contract; docs/SERVING.md pins this tolerance)
+        (jnp.float32, jnp.float32, 1e-5),
+        # bf16 compute, bf16 cache: one bf16 rounding per cache write on
+        # top of bf16 matmul noise
+        (jnp.bfloat16, jnp.bfloat16, 0.05),
+    ])
+    def test_matches_oneshot_logits(self, compute, cache_dtype, tol):
+        model, params = _tiny_model(compute)
+        rng = np.random.RandomState(0)
+        n, P, S = 12, 8, 3
+        tokens = jnp.asarray(rng.randint(0, 97, (1, n)))
+        oneshot = np.asarray(model(params, tokens), np.float32)
+
+        cache = KVCache.create(2, S, 4, 16, 8, dtype=cache_dtype)
+        logits_p, cache = model.forward(params, tokens[:, :P],
+                                        kv_cache=cache, slot=1)
+        np.testing.assert_allclose(np.asarray(logits_p[0], np.float32),
+                                   oneshot[0, :P], atol=tol)
+        # teacher-forced decode of the remaining positions on slot 1 (the
+        # other slots stay empty and step along — the fixed-shape grid)
+        for t in range(P, n):
+            dt = jnp.zeros((S, 1), tokens.dtype).at[1, 0].set(tokens[0, t])
+            logits_d, cache = model.forward(params, dt, kv_cache=cache)
+            np.testing.assert_allclose(np.asarray(logits_d[1], np.float32),
+                                       oneshot[0, t], atol=tol)
+        assert int(cache.lengths[1]) == n
+
+    def test_int8_cache_stays_close(self):
+        """int8 cache: quantization error bounded, ranking mostly
+        preserved on the tiny model (argmax agreement is the serving
+        quantity that matters)."""
+        model, params = _tiny_model(jnp.float32)
+        rng = np.random.RandomState(1)
+        n, P = 10, 6
+        tokens = jnp.asarray(rng.randint(0, 97, (1, n)))
+        oneshot = np.asarray(model(params, tokens), np.float32)
+        cache = KVCache.create(2, 1, 4, 16, 8, dtype=jnp.int8)
+        _, cache = model.forward(params, tokens[:, :P], kv_cache=cache,
+                                 slot=0)
+        agree = 0
+        for t in range(P, n):
+            logits_d, cache = model.forward(params, tokens[:, t][:, None],
+                                            kv_cache=cache)
+            agree += int(np.argmax(np.asarray(logits_d[0]))
+                         == np.argmax(oneshot[0, t]))
+        assert agree >= (n - P) - 1
+
+    def test_prompt_padding_is_invisible(self):
+        """A right-padded prompt (prompt_len < window) must produce the
+        same decode trajectory as an exact-width prefill: the cursor
+        masks the pad garbage and the appends overwrite it."""
+        model, params = _tiny_model(jnp.float32)
+        toks = [5, 6, 7]
+
+        def run(window):
+            cache = KVCache.create(2, 1, 4, 16, 8, dtype=jnp.float32)
+            padded = np.zeros((1, window), np.int32)
+            padded[0, : len(toks)] = toks
+            _, cache = model.forward(params, jnp.asarray(padded),
+                                     kv_cache=cache, slot=0,
+                                     prompt_len=len(toks))
+            out, _ = model.forward(params, jnp.asarray([[9]]),
+                                   kv_cache=cache)
+            return np.asarray(out)
+
+        np.testing.assert_allclose(run(3), run(8), atol=1e-5)
+
+    def test_prompt_len_outside_window_guarded(self):
+        """A cursor past the written window would make every later
+        decode read stale cache: static prompt_len is rejected, a
+        traced one (the AOT engine path) is clamped."""
+        model, params = _tiny_model(jnp.float32)
+        tokens = jnp.asarray([[1, 2, 3, 4]])
+        cache = KVCache.create(2, 1, 4, 16, 8, dtype=jnp.float32)
+        with pytest.raises(ValueError, match="written window"):
+            model.forward(params, tokens, kv_cache=cache, slot=0,
+                          prompt_len=7)
+        _, out_cache = jax.jit(
+            lambda p, c, pl: model.forward(p, tokens, kv_cache=c,
+                                           slot=0, prompt_len=pl)
+        )(params, cache, jnp.asarray(7, jnp.int32))
+        assert int(out_cache.lengths[0]) == 4  # clamped to the window
+
+    def test_forward_without_cache_is_call(self):
+        model, params = _tiny_model(jnp.float32)
+        tokens = jnp.asarray([[1, 2, 3]])
+        np.testing.assert_array_equal(
+            np.asarray(model.forward(params, tokens)),
+            np.asarray(model(params, tokens)))
+
+    def test_tp_refused(self):
+        cfg = GPTConfig(vocab_size=32, hidden_size=16, num_layers=1,
+                        num_attention_heads=2, max_position_embeddings=8,
+                        tensor_model_parallel_size=2)
+        model = GPTModel(cfg)
+        with pytest.raises(NotImplementedError, match="tp=1"):
+            model.forward({}, jnp.zeros((1, 4), jnp.int32),
+                          kv_cache=KVCache.create(1, 1, 2, 8, 8))
+
+
+# ---------------------------------------------------------------------------
+# AOT engine: donation, live buffers, zero recompiles
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def engine():
+    model, params = _tiny_model(jnp.float32)
+    return ServingEngine(model, params, max_seqs=2, max_len=16,
+                         prefill_len=8)
+
+
+class TestEngineContracts:
+    def test_cache_donation_aliased(self, engine):
+        """Every cache leaf must be input/output-aliased in BOTH compiled
+        programs: alias_bytes covers the whole cache, so decode steps do
+        zero cache allocation (the PR 4 donation-test methodology)."""
+        for compiled in (engine.decode_compiled, engine.prefill_compiled):
+            assert "input_output_alias" in compiled.as_text()
+            ma = compiled.memory_analysis()
+            assert int(ma.alias_size_in_bytes) >= engine.cache.nbytes()
+
+    def test_live_buffers_consumed(self, engine):
+        """The donated cache buffers die at each call — the step updates
+        in place instead of copying."""
+        old = jax.tree_util.tree_leaves(engine.cache)
+        engine.prefill([1, 2, 3], slot=0)
+        assert all(leaf.is_deleted() for leaf in old)
+        old = jax.tree_util.tree_leaves(engine.cache)
+        engine.decode(np.zeros(2, np.int32), np.zeros(2, np.float32))
+        assert all(leaf.is_deleted() for leaf in old)
+
+    def test_zero_recompiles_across_steps(self, engine):
+        """After one warm call of each program, admissions/decodes/
+        retirements must never trace or compile again — the compile-storm
+        counters (PR 1) stay flat."""
+        from apex_tpu import observability as obs
+        reg = MetricsRegistry()
+        # warm every host path once (prefill, decode, release, rng
+        # split, asarray)
+        engine.prefill([1, 2], slot=0)
+        engine.decode(np.zeros(2, np.int32), np.zeros(2, np.float32))
+        engine.release_slot(0)
+        obs.install_compile_listeners(reg)
+        try:
+            before = dict(reg.snapshot())
+            for i in range(4):
+                engine.prefill([1, 2, 3], slot=i % 2)
+                engine.decode(np.asarray([i, i + 1], np.int32),
+                              np.asarray([0.0, 0.7], np.float32))
+                engine.release_slot(i % 2)
+            after = reg.snapshot()
+        finally:
+            obs.uninstall_compile_listeners(reg)
+        for name in ("jax/compiles", "jax/traces", "jax/lowerings"):
+            assert after.get(name, 0.0) == before.get(name, 0.0), (
+                name, before, after)
+
+    def test_capacity_math(self, engine):
+        per_slot = engine.bytes_per_slot()
+        # the engine default cache dtype is bf16 regardless of compute
+        assert per_slot == cache_bytes_per_slot(2, 4, 16, 8, jnp.bfloat16)
+        overhead = engine.overhead_bytes()
+        hbm = 1 << 30
+        suggested = engine.suggest_max_seqs(hbm, reserve_fraction=0.1)
+        if overhead is not None:
+            assert suggested == (int(hbm * 0.9) - overhead) // per_slot
+        assert engine.suggest_max_seqs(0) == 0  # no HBM, no slots
+        # monotone in memory
+        assert engine.suggest_max_seqs(2 * hbm) >= suggested
+
+    def test_prompt_too_long_rejected(self, engine):
+        with pytest.raises(ValueError, match="prefill window"):
+            engine.prefill(list(range(9)), slot=0)
+
+    def test_out_of_range_slot_rejected(self, engine):
+        """An out-of-range slot would CLAMP inside the compiled
+        dynamic_update_slice and silently clobber the last valid slot's
+        in-flight sequence — it must bounce at the host boundary."""
+        before = np.asarray(engine.cache.lengths)
+        for slot in (engine.max_seqs, -1):
+            with pytest.raises(ValueError, match="out of range"):
+                engine.prefill([1, 2], slot=slot)
+        np.testing.assert_array_equal(np.asarray(engine.cache.lengths),
+                                      before)
+
+    def test_prefill_last_logit_only_matches_full_head(self):
+        """The engine's single-row head projection equals the full-head
+        logits at prompt_len - 1 (the head is per-position, so gathering
+        the hidden row first changes nothing but the FLOPs)."""
+        model, params = _tiny_model(jnp.float32)
+        tokens = jnp.asarray([[3, 1, 4, 1, 5, 0, 0, 0]])
+
+        def run(last_only):
+            cache = KVCache.create(2, 1, 4, 16, 8, dtype=jnp.float32)
+            lg, _ = model.forward(params, tokens, kv_cache=cache, slot=0,
+                                  prompt_len=5, last_logit_only=last_only)
+            return np.asarray(lg)
+
+        full, last = run(False), run(True)
+        assert last.shape == (1, 1, full.shape[-1])
+        np.testing.assert_allclose(last[0, 0], full[0, 4], atol=1e-6)
+
+    def test_rng_varies_sampling(self):
+        """Two stochastic decodes of the same state draw different rngs
+        (the engine splits its key per call)."""
+        model, params = _tiny_model(jnp.float32)
+        eng = ServingEngine(model, params, max_seqs=1, max_len=16,
+                            prefill_len=4)
+        k1 = eng._next_key()
+        k2 = eng._next_key()
+        assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+
+
+# ---------------------------------------------------------------------------
+# sampling
+# ---------------------------------------------------------------------------
+
+class TestSampling:
+    def test_greedy_and_topk1(self):
+        rng = jax.random.PRNGKey(0)
+        logits = jnp.asarray(np.random.RandomState(0).randn(5, 33),
+                             jnp.float32)
+        greedy = sample_tokens(logits, rng, jnp.zeros(5))
+        np.testing.assert_array_equal(np.asarray(greedy),
+                                      np.argmax(np.asarray(logits), -1))
+        topk1 = sample_tokens(logits, rng, jnp.full(5, 1.0), top_k=1)
+        np.testing.assert_array_equal(np.asarray(topk1),
+                                      np.asarray(greedy))
+
+    def test_topk_restricts_support(self):
+        logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0]] * 64, jnp.float32)
+        toks = sample_tokens(logits, jax.random.PRNGKey(1),
+                             jnp.full(64, 5.0), top_k=2)
+        assert set(np.asarray(toks).tolist()) <= {2, 3}
+
+    def test_mixed_batch_greedy_rows_deterministic(self):
+        logits = jnp.asarray(np.random.RandomState(1).randn(4, 16),
+                             jnp.float32)
+        temps = jnp.asarray([0.0, 1.0, 0.0, 1.0])
+        a = sample_tokens(logits, jax.random.PRNGKey(2), temps)
+        b = sample_tokens(logits, jax.random.PRNGKey(3), temps)
+        np.testing.assert_array_equal(np.asarray(a)[[0, 2]],
+                                      np.asarray(b)[[0, 2]])
+
+
+# ---------------------------------------------------------------------------
+# continuous slot batching
+# ---------------------------------------------------------------------------
+
+def _sched(max_seqs=2, max_len=32, prefill_len=8, **kw):
+    model, params = _tiny_model(jnp.float32)
+    eng = ServingEngine(model, params, max_seqs=max_seqs, max_len=max_len,
+                        prefill_len=prefill_len, **kw)
+    reg = MetricsRegistry()
+    return SlotScheduler(eng, registry=reg), reg
+
+
+class TestSlotScheduler:
+    def test_all_requests_complete_with_exact_lengths(self):
+        sched, reg = _sched()
+        reqs = [Request(prompt=[1 + i, 2, 3], max_new_tokens=2 + i)
+                for i in range(5)]
+        out = sched.run(reqs)
+        assert sorted(out) == list(range(5))
+        for i, c in sorted(out.items()):
+            assert c.finish_reason == "length"
+            assert len(c.tokens) == 2 + i
+        snap = reg.snapshot()
+        assert snap["serve/admitted"] == 5.0
+        assert snap["serve/retired"] == 5.0
+        assert snap["serve/prefill_tokens"] == 15.0
+        assert snap["serve/generated_tokens"] == sum(2 + i
+                                                     for i in range(5))
+        assert snap["serve/active_slots"] == 0.0
+        assert snap["serve/queue_depth"] == 0.0
+        assert snap["serve/tokens_per_sec"] > 0.0
+
+    def test_no_batch_barrier(self):
+        """A short request retires mid-flight and its slot is re-admitted
+        while the long request keeps decoding — the continuous-batching
+        property itself."""
+        sched, _ = _sched(max_seqs=2)
+        long_id = sched.submit(Request(prompt=[1], max_new_tokens=12))
+        short_id = sched.submit(Request(prompt=[2], max_new_tokens=3))
+        late_id = sched.submit(Request(prompt=[3], max_new_tokens=2))
+        # 2 slots: long+short admitted; late queued
+        sched.step()
+        assert sched.pending == 3 and len(sched.queue) == 1
+        while not any(c.request_id == short_id for c in sched.completed):
+            sched.step()
+        done_at_short = {c.request_id for c in sched.completed}
+        assert long_id not in done_at_short  # long is still mid-flight
+        sched.run([])  # drain
+        result = {c.request_id: c for c in sched.completed}
+        assert len(result[late_id].tokens) == 2
+        assert len(result[long_id].tokens) == 12
+        # the late request was admitted into the freed slot and COMPLETED
+        # before the long one finished — no barrier (with one, late could
+        # only start after both retire)
+        order = [c.request_id for c in sched.completed]
+        assert order.index(late_id) < order.index(long_id)
+
+    def test_eos_and_capacity_stops(self):
+        sched, _ = _sched(max_seqs=1, max_len=6, prefill_len=4)
+        # the tiny random model repeats a token; use it as eos
+        probe = sched.run([Request(prompt=[1, 2], max_new_tokens=3)])
+        eos = probe[0].tokens[-1]
+        sched2, _ = _sched(max_seqs=1, max_len=6, prefill_len=4)
+        out = sched2.run([
+            Request(prompt=[1, 2], max_new_tokens=50, eos_token=eos),
+            Request(prompt=[1, 2, 3], max_new_tokens=50),
+        ])
+        assert out[0].finish_reason == "eos"
+        # 6-token cache, 3-token prompt: capacity retires it
+        assert out[1].finish_reason == "capacity"
+        assert len(out[1].tokens) == 3
+
+    def test_single_token_request_completes_at_prefill(self):
+        sched, reg = _sched(max_seqs=2)
+        out = sched.run([Request(prompt=[4, 5], max_new_tokens=1)])
+        assert len(out[0].tokens) == 1
+        assert reg.snapshot().get("serve/decode_steps", 0.0) == 0.0
+
+    def test_int8_engine_serves(self):
+        sched, _ = _sched(cache_dtype=jnp.int8)
+        out = sched.run([Request(prompt=[1, 2, 3], max_new_tokens=4)])
+        assert len(out[0].tokens) == 4
+
+    def test_submit_rejects_bad_prompts_loop_stays_alive(self):
+        """Validation happens at submit, not mid-step: a bad request
+        bounces off the caller and never kills the serving loop."""
+        sched, _ = _sched()
+        with pytest.raises(ValueError, match="prefill window"):
+            sched.submit(Request(prompt=list(range(9))))
+        with pytest.raises(ValueError, match="empty"):
+            sched.submit(Request(prompt=[]))
+        assert sched.pending == 0
+        out = sched.run([Request(prompt=[1], max_new_tokens=2)])
+        assert len(out[0].tokens) == 2
+
+    def test_free_slots_never_grow_cursors(self):
+        """Freed slots must not keep (or grow) cursors: the decode
+        kernel's compute-skip prices a slot's math O(cursor), so a
+        retired sequence left in place — or a free slot creeping one
+        garbage position per step — would tax every later step. Retire
+        resets (release_slot) and the decode active-mask freezes idle
+        cursors."""
+        sched, _ = _sched(max_seqs=2)
+        sched.run([Request(prompt=[1, 2, 3], max_new_tokens=10)])
+        # slot 0 ran 10 tokens then released; slot 1 idled 9 steps
+        np.testing.assert_array_equal(
+            np.asarray(sched.engine.cache.lengths), [0, 0])
+
+    def test_submit_rejects_nonpositive_max_new_tokens(self):
+        sched, _ = _sched()
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            sched.submit(Request(prompt=[1], max_new_tokens=0))
+        assert sched.pending == 0
+
+    def test_run_returns_only_this_runs_completions(self):
+        sched, _ = _sched()
+        first = sched.run([Request(prompt=[1], max_new_tokens=2)])
+        second = sched.run([Request(prompt=[2], max_new_tokens=3,
+                                    request_id=7)])
+        assert sorted(first) == [0] and sorted(second) == [7]
+        # the buffer holds both until drained; draining empties it
+        assert {c.request_id for c in sched.completed} == {0, 7}
+        assert len(sched.drain_completed()) == 2
+        assert sched.completed == []
