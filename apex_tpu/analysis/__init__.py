@@ -1,0 +1,59 @@
+"""Unified static-analysis engine: jaxpr program lints + AST contract
+checks.
+
+Two rule families behind one registry and one CLI
+(``python -m apex_tpu.analysis [--all|--rule NAME] [--json]``):
+
+- **Family A (jaxpr)** — :mod:`apex_tpu.analysis.program`: rules that
+  take a traced/lowered/compiled program and emit structured findings
+  for the bug classes this repo previously caught late with hand-written
+  one-off checks — donation safety (PR 9's double-donated scale buffer),
+  collective chokepoint placement at the equation level, the
+  flat-gradient materialization barrier (PR 8), shared-grad replication
+  soundness under ``shard_map_unchecked`` (PR 7's drift), and the
+  zero-recompile budget (:class:`recompile_guard`).
+- **Family B (ast)** — :mod:`apex_tpu.analysis.rules_ast`: the six
+  ``scripts/check_*.py`` contract checks consolidated onto one AST-walk
+  core (:mod:`apex_tpu.analysis.astlint`), plus the metric-family
+  meta-lint. The scripts remain as thin shims.
+
+Shared jaxpr walks live in :mod:`apex_tpu.analysis.jaxpr` (promoted from
+``tests/_jaxpr_utils.py``). Rule table + allowlisting instructions:
+``docs/ANALYSIS.md``.
+
+The analysis modules themselves import no jax until a Family-A rule
+actually runs (Family B is stdlib-``ast`` only), so the AST family and
+the script shims stay pre-commit fast — the only jax cost at import is
+the parent package's own.
+"""
+
+from apex_tpu.analysis.core import (  # noqa: F401
+    AnalysisError, Finding, Rule, RULES, format_finding, get_rule,
+    iter_rules, register)
+from apex_tpu.analysis.rules_ast import (  # noqa: F401
+    rule_annotations, rule_bench_configs, rule_collectives,
+    rule_elastic_exits, rule_metric_families, rule_metrics_doc,
+    rule_remat_names)
+
+__all__ = ["AnalysisError", "Finding", "Rule", "RULES", "format_finding",
+           "get_rule", "iter_rules", "register",
+           # Family A (lazy: importing them pulls jax)
+           "check_donation", "check_collective_placement",
+           "check_flat_materialization", "check_shared_grad_reduction",
+           "lint_program", "lint_trainer_step", "lint_serving_engine",
+           "recompile_guard", "verify_findings",
+           "DEFAULT_BLESSED_SCOPES", "GRAD_SYNC_COLLECTIVES"]
+
+_PROGRAM_NAMES = ("check_donation", "check_collective_placement",
+                  "check_flat_materialization",
+                  "check_shared_grad_reduction", "lint_program",
+                  "lint_trainer_step", "lint_serving_engine",
+                  "recompile_guard", "verify_findings",
+                  "DEFAULT_BLESSED_SCOPES", "GRAD_SYNC_COLLECTIVES")
+
+
+def __getattr__(name):
+    if name in _PROGRAM_NAMES:
+        from apex_tpu.analysis import program
+        return getattr(program, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

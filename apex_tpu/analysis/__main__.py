@@ -1,0 +1,112 @@
+"""CLI: ``python -m apex_tpu.analysis [--all|--rule NAME] [--json]``.
+
+Family-B (ast) rules run over this repository tree; Family-A (jaxpr)
+rules run their built-in selfchecks — each rule's tiny clean program must
+stay silent AND its planted violation must fire, so a green ``--all``
+proves every rule in both directions (a rule that stopped firing is as
+rotten as a tree that stopped passing). Exit status: 0 clean, 1 findings
+(or a broken selfcheck), 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from apex_tpu.analysis.astlint import repo_root
+from apex_tpu.analysis.core import (format_finding, get_rule, iter_rules)
+
+
+def _run_ast(rule, repo, out):
+    findings, notes = rule.run(repo)
+    out["rules"].append({
+        "rule": rule.name, "family": "ast", "ok": not findings,
+        "findings": [f.to_dict() for f in findings],
+        "checked": len(notes)})
+    return findings, [f"{rule.name}: {len(notes)} site(s) checked"]
+
+
+def _run_jaxpr(rule, out):
+    clean, planted = rule.selfcheck()
+    ok = not clean and bool(planted)
+    out["rules"].append({
+        "rule": rule.name, "family": "jaxpr", "ok": ok,
+        "findings": [f.to_dict() for f in clean],
+        "planted_fired": len(planted)})
+    findings = list(clean)
+    notes = []
+    if clean:
+        notes.append(f"{rule.name}: selfcheck FALSE-POSITIVE on the "
+                     f"clean program")
+    elif not planted:
+        notes.append(f"{rule.name}: selfcheck planted violation did NOT "
+                     f"fire — the rule is dead")
+    else:
+        notes.append(f"{rule.name}: selfcheck ok (clean silent, planted "
+                     f"fires {len(planted)} finding(s))")
+    return findings, notes, bool(planted)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m apex_tpu.analysis",
+        description="unified static-analysis engine: jaxpr program lints "
+                    "+ AST contract checks (docs/ANALYSIS.md)")
+    group = parser.add_mutually_exclusive_group()
+    group.add_argument("--all", action="store_true",
+                       help="run every registered rule (default)")
+    group.add_argument("--rule", help="run one rule by name")
+    group.add_argument("--list", action="store_true",
+                       help="list registered rules")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable findings on stdout")
+    parser.add_argument("--repo", default=None,
+                        help="repo root for the ast family (default: "
+                             "the tree this package is installed from)")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for rule in iter_rules():
+            print(f"{rule.name:<22} [{rule.family:>5}]  {rule.doc}")
+        return 0
+
+    try:
+        rules = [get_rule(args.rule)] if args.rule else list(iter_rules())
+    except KeyError as e:
+        print(e.args[0], file=sys.stderr)
+        return 2
+
+    repo = args.repo or repo_root()
+    out = {"repo": repo, "rules": []}
+    all_findings, report, dead = [], [], []
+    for rule in rules:
+        if rule.family == "ast":
+            findings, notes = _run_ast(rule, repo, out)
+            all_findings += findings
+            report += notes
+        else:
+            clean, notes, fired = _run_jaxpr(rule, out)
+            all_findings += clean
+            report += notes
+            if not fired:
+                dead.append(rule.name)
+
+    ok = not all_findings and not dead
+    if args.as_json:
+        out["ok"] = ok
+        print(json.dumps(out, indent=2))
+    else:
+        for line in report:
+            print(line)
+        for f in all_findings:
+            print(format_finding(f))
+        verdict = "clean" if ok else \
+            f"{len(all_findings)} finding(s)" + \
+            (f", dead rule(s): {dead}" if dead else "")
+        print(f"apex_tpu.analysis: {len(rules)} rule(s) -> {verdict}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

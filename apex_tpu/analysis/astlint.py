@@ -1,0 +1,95 @@
+"""Shared AST-walk core for the Family-B repo lints.
+
+Every historical ``scripts/check_*.py`` carried its own copy of the same
+boilerplate: walk the package for ``.py`` files, parse each, extract
+callee names / literal strings, format a report. That lives here once;
+:mod:`apex_tpu.analysis.rules_ast` holds only each rule's actual policy.
+
+No jax import anywhere on this path — the AST family stays pre-commit
+fast and runs on hosts with no accelerator stack.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator, Optional, Tuple
+
+__all__ = ["repo_root", "iter_py_files", "iter_package_trees",
+           "callee_name", "literal_str", "tuple_literal", "parse_file"]
+
+PACKAGE = "apex_tpu"
+
+
+def repo_root() -> str:
+    """The repository root, resolved from the installed package location
+    (``<repo>/apex_tpu/analysis/astlint.py``)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def iter_py_files(root: str) -> Iterator[str]:
+    """Every ``.py`` under ``root``, sorted for stable reports."""
+    for dirpath, _dirnames, filenames in sorted(os.walk(root)):
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                yield os.path.join(dirpath, fname)
+
+
+def parse_file(path: str, rel: str) -> Optional[ast.AST]:
+    """Parse one file; unparseable sources are skipped (they are the
+    interpreter's problem, not a lint's)."""
+    with open(path) as f:
+        try:
+            return ast.parse(f.read(), filename=rel)
+        except SyntaxError:
+            return None
+
+
+def iter_package_trees(repo: str, package: str = PACKAGE
+                       ) -> Iterator[Tuple[str, ast.AST]]:
+    """``(relpath, parsed_tree)`` for every parseable ``.py`` in the
+    package under ``repo``."""
+    pkg_root = os.path.join(repo, package)
+    for path in iter_py_files(pkg_root):
+        rel = os.path.relpath(path, repo)
+        tree = parse_file(path, rel)
+        if tree is not None:
+            yield rel, tree
+
+
+def callee_name(node: ast.Call) -> Optional[str]:
+    """The terminal callee name of a call: ``f(...)`` -> ``f``,
+    ``obj.attr(...)`` -> ``attr``, anything else -> None."""
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def literal_str(node) -> Optional[str]:
+    """A statically-known string: plain literals pass through, f-strings
+    normalize each formatted field to a ``<>`` placeholder
+    (``f"health/{name}/l2"`` -> ``health/<>/l2``), anything else is
+    None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for piece in node.values:
+            if isinstance(piece, ast.Constant):
+                parts.append(str(piece.value))
+            else:  # FormattedValue
+                parts.append("<>")
+        return "".join(parts)
+    return None
+
+
+def tuple_literal(node) -> list:
+    """The string elements of a tuple/list literal."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []

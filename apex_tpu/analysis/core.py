@@ -1,0 +1,125 @@
+"""Rule registry + structured findings for the static-analysis engine.
+
+One vocabulary for both rule families:
+
+- **Family A (jaxpr)** — program lints: a rule takes a traced / lowered /
+  compiled program (plus rule-specific context) and returns
+  :class:`Finding`\\ s. They run wherever a program exists — construction
+  self-checks (``ServingEngine``), the dryrun gate, tests — and each one
+  carries a CLI ``selfcheck`` that proves the rule on a tiny built-in
+  clean/planted program pair.
+- **Family B (ast)** — repo lints: a rule takes a repo root and AST-walks
+  the package (no jax import). These are the six historical
+  ``scripts/check_*.py`` contracts plus the metric-family meta-lint,
+  consolidated onto one walker core (:mod:`apex_tpu.analysis.astlint`).
+
+``python -m apex_tpu.analysis --all`` runs every registered rule; each
+``scripts/check_*.py`` shim runs exactly its ported rule with the
+historical ``check(repo) -> (ok, lines)`` surface preserved.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = ["Finding", "Rule", "AnalysisError", "RULES", "register",
+           "get_rule", "iter_rules", "format_finding", "findings_to_ok_lines"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One structured violation.
+
+    ``rule`` names the emitting rule; ``kind`` is the short marker the
+    historical scripts printed (``RAW``, ``UNDOC``, ``ORPHAN``, ``EXIT``,
+    ``MISSING``, ``UNKNOWN``, ``CHOKE``, and the new jaxpr-rule markers);
+    ``where`` locates it (``file:line`` for AST rules, a program/equation
+    description for jaxpr rules); ``message`` says what broke and how to
+    fix or allowlist it.
+    """
+    rule: str
+    kind: str
+    where: str
+    message: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class AnalysisError(RuntimeError):
+    """A self-check or construction-time lint failed. Carries the
+    findings that fired."""
+
+    def __init__(self, message: str, findings: Tuple[Finding, ...] = ()):
+        super().__init__(message)
+        self.findings = tuple(findings)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One registered rule.
+
+    ``run``: for AST rules, ``run(repo) -> (findings, notes)`` where
+    ``notes`` are the ``ok``-class report lines the historical scripts
+    printed. jaxpr rules have no repo-wide ``run``; they are invoked
+    programmatically (see :mod:`apex_tpu.analysis.program`) and expose
+    ``selfcheck() -> (clean_findings, planted_findings)`` instead — the
+    CLI asserts the clean program stays silent AND the planted violation
+    fires, so ``--all`` proves every rule in both directions.
+    """
+    name: str
+    family: str  # 'ast' | 'jaxpr'
+    doc: str     # one line: the real bug class this rule encodes
+    run: Optional[Callable[[str], Tuple[List[Finding], List[str]]]] = None
+    selfcheck: Optional[
+        Callable[[], Tuple[List[Finding], List[Finding]]]] = None
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(rule: Rule) -> Rule:
+    if rule.name in RULES:
+        raise ValueError(f"duplicate rule name {rule.name!r}")
+    if rule.family not in ("ast", "jaxpr"):
+        raise ValueError(f"unknown rule family {rule.family!r}")
+    RULES[rule.name] = rule
+    return rule
+
+
+def get_rule(name: str) -> Rule:
+    _ensure_loaded()
+    try:
+        return RULES[name]
+    except KeyError:
+        known = ", ".join(sorted(RULES))
+        raise KeyError(f"unknown rule {name!r}; registered: {known}")
+
+
+def iter_rules(family: Optional[str] = None):
+    _ensure_loaded()
+    for name in sorted(RULES):
+        rule = RULES[name]
+        if family is None or rule.family == family:
+            yield rule
+
+
+def _ensure_loaded() -> None:
+    """Rule modules register on import; AST rules are import-light
+    (stdlib ast only), jaxpr rules import jax lazily inside their
+    bodies."""
+    from apex_tpu.analysis import program, rules_ast  # noqa: F401
+
+
+def format_finding(f: Finding) -> str:
+    return f"{f.kind:<8} {f.where}: {f.message}" if f.where else \
+        f"{f.kind:<8} {f.message}"
+
+
+def findings_to_ok_lines(findings: List[Finding],
+                         notes: List[str]) -> Tuple[bool, List[str]]:
+    """The historical ``check(repo) -> (ok, report_lines)`` shape the
+    script shims preserve."""
+    lines = list(notes) + [format_finding(f) for f in findings]
+    return not findings, lines

@@ -163,11 +163,22 @@ class ElasticRunner:
 
     # -- the loop ---------------------------------------------------------
     def fit(self, steps: int, *, key: Optional[jax.Array] = None,
-            state: Optional[tuple] = None) -> FitResult:
+            state: Optional[tuple] = None,
+            no_recompile: bool = False) -> FitResult:
         """Run until ``steps`` total steps are COMPLETED (counting the
         restored prefix), checkpointing on the way. ``state`` overrides
         the freshly-initialized state used as the restore target (its
-        shapes/dtypes/shardings define the checkpoint layout)."""
+        shapes/dtypes/shardings define the checkpoint layout).
+
+        ``no_recompile=True`` wraps the step loop in the analysis
+        engine's :class:`~apex_tpu.analysis.program.recompile_guard`:
+        the first iteration (including its save, whose fp32-cast path
+        compiles once) is the warmup baseline; any compile-storm counter
+        movement after it raises ``AnalysisError`` — a shape or
+        static-arg leak retracing the production step fails loudly
+        instead of silently multiplying step time."""
+        from contextlib import nullcontext
+
         if state is None:
             state = self.trainer.init_state(
                 key if key is not None else jax.random.PRNGKey(0))
@@ -178,22 +189,54 @@ class ElasticRunner:
             ar = AutoResume(interval=1)
         step_fn = self.trainer.jit_train_step()
         loss = None
+        if no_recompile:
+            from apex_tpu.analysis.program import recompile_guard
+            guard = recompile_guard("ElasticRunner.fit")
+        else:
+            guard = nullcontext()
+        warm_steps, saved_once = 0, False
+        preempted = False
         try:
-            while step < steps:
-                if self.fault_plan is not None:
-                    self.fault_plan.before_step(step)
-                if ar.termination_requested(step):
-                    return self._preempt(ar, state, step, loss,
-                                         restored_from)
-                batch = next(self.data)
-                loss, *state = step_fn(*state, *batch)
-                state = tuple(state)
-                step += 1
-                if self.on_step is not None:
-                    self.on_step(step, loss)
-                if step % self.save_interval == 0 and step < steps:
-                    self.ckpt.save(state, step,
-                                   host_state=self._host_state(step))
+            # the guard covers ONLY the steady-state loop: the preempt
+            # drain and the final checkpoint are one-shot paths whose
+            # first-use compiles (fp32-on-disk casts) are not a storm
+            with guard:
+                while step < steps:
+                    if self.fault_plan is not None:
+                        self.fault_plan.before_step(step)
+                    if ar.termination_requested(step):
+                        preempted = True
+                        break
+                    batch = next(self.data)
+                    loss, *state = step_fn(*state, *batch)
+                    state = tuple(state)
+                    step += 1
+                    if self.on_step is not None:
+                        self.on_step(step, loss)
+                    saved = False
+                    if step % self.save_interval == 0 and step < steps:
+                        self.ckpt.save(state, step,
+                                       host_state=self._host_state(step))
+                        saved = True
+                    # warmup baselines: the first TWO dispatches compile
+                    # the step (a freshly-initialized state and the
+                    # donated step outputs differ in sharding
+                    # memory-kind, so iteration 2 legitimately adds a
+                    # second cache entry), and the first save compiles
+                    # the storage casts — all expected; anything after
+                    # them is the leak. The first save is drained so its
+                    # async worker's compiles land BEFORE the rebase,
+                    # not racing it.
+                    if no_recompile and (warm_steps < 2
+                                         or (saved and not saved_once)):
+                        if saved and not saved_once:
+                            self.ckpt.drain()
+                        guard.rebase()
+                    warm_steps += 1
+                    saved_once = saved_once or saved
+            if preempted:
+                return self._preempt(ar, state, step, loss,
+                                     restored_from)
             # run complete: drain the tail save, then commit the final one
             self.ckpt.drain()
             if ar.termination_requested(step):

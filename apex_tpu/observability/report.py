@@ -134,7 +134,7 @@ class StepReporter:
 
     def attach_attribution(self, report) -> "StepReporter":
         """Set the ``perf/*`` attribution gauges from an
-        :class:`~apex_tpu.pyprof.attribute.AttributionReport` —
+        :class:`~apex_tpu.pyprof._attribute.AttributionReport` —
         ``perf/modeled_step_ms`` (the roofline lower bound of the step),
         ``perf/comm_exposed_ms`` (modeled communication the measured step
         failed to hide under compute) and ``perf/overlap_efficiency``

@@ -13,13 +13,13 @@ of the same three stages for JAX/XLA programs:
 - **trace** — ``apex_tpu.utils.timers.profile_trace`` (``jax.profiler``)
   for device traces, or the host-side span buffer in
   :mod:`apex_tpu.observability.trace`; either joins back via
-  :func:`~apex_tpu.pyprof.attribute.region_times_from_trace_dir` /
-  :func:`~apex_tpu.pyprof.attribute.region_times_from_spans`;
+  :func:`~apex_tpu.pyprof._attribute.region_times_from_trace_dir` /
+  :func:`~apex_tpu.pyprof._attribute.region_times_from_spans`;
 - **attribute** — :func:`~apex_tpu.pyprof.model.model_program` prices
   every region against the chip's roofline
   (:class:`~apex_tpu.observability.costs.DeviceSpec`), and
-  :func:`~apex_tpu.pyprof.attribute.attribute` joins the model with a
-  measured step into an :class:`~apex_tpu.pyprof.attribute.
+  :func:`~apex_tpu.pyprof._attribute.attribute` joins the model with a
+  measured step into an :class:`~apex_tpu.pyprof._attribute.
   AttributionReport` (markdown table, JSONL, and the
   ``perf/modeled_step_ms`` / ``perf/comm_exposed_ms`` /
   ``perf/overlap_efficiency`` gauges via
@@ -32,6 +32,14 @@ trainer's own jitted step.
 The NVTX-era module names (``pyprof.nvtx``, ``pyprof.prof``,
 ``pyprof.parse``) remain importable attributes that raise with a
 migration pointer — the contract the old stub documented.
+
+The attribution code lives in ``pyprof/_attribute.py`` (underscored ON
+PURPOSE, names re-exported here): a ``pyprof/attribute.py`` submodule
+would collide with the :func:`attribute` entry point — ``import
+apex_tpu.pyprof.attribute`` makes the import system rebind the package
+attribute to the module, silently clobbering the function process-wide
+(the accepted-wart from PR 6, fixed in PR 11 with a regression test in
+``tests/test_pyprof.py``).
 """
 
 from jax import named_scope as annotate  # noqa: F401 — the annotate stage
@@ -39,7 +47,7 @@ from jax import named_scope as annotate  # noqa: F401 — the annotate stage
 from apex_tpu.pyprof.model import (  # noqa: F401
     DEFAULT_REGIONS, ProgramCost, RegionCost, UNATTRIBUTED, jaxpr_of,
     model_program)
-from apex_tpu.pyprof.attribute import (  # noqa: F401
+from apex_tpu.pyprof._attribute import (  # noqa: F401
     AttributionReport, RegionAttribution, attribute,
     region_times_from_spans, region_times_from_trace_dir)
 from apex_tpu.pyprof.tune import (  # noqa: F401

@@ -137,6 +137,17 @@ class ServingEngine:
             release_step, donate_argnums=(0,)).trace(
                 self.cache, ex_scalar).lower().compile()
 
+        # construction-time donation self-check (analysis rule
+        # jaxpr-donation, docs/ANALYSIS.md): every cache leaf must be
+        # input/output-aliased in all three compiled programs, and no
+        # two cache leaves may share one buffer — a KVCache built with a
+        # shared scale plane would donate the SAME buffer twice, the
+        # exact class PR 9's review caught by hand
+        from apex_tpu.analysis.program import (lint_serving_engine,
+                                               verify_findings)
+        verify_findings(lint_serving_engine(self),
+                        "ServingEngine construction")
+
     # -- stepping -----------------------------------------------------------
 
     def _next_key(self) -> jax.Array:

@@ -185,19 +185,38 @@ class SlotScheduler:
         return out
 
     def run(self, requests: Sequence[Request],
-            max_steps: Optional[int] = None) -> Dict[int, Completion]:
+            max_steps: Optional[int] = None,
+            no_recompile: bool = False) -> Dict[int, Completion]:
         """Submit ``requests``, loop :meth:`step` until all complete (or
         ``max_steps``), and return ``{request_id: Completion}`` for the
         completions of THIS run (requests finishing during it —
         including ones submitted before the call); earlier runs' results
-        stay in :attr:`completed` until drained."""
+        stay in :attr:`completed` until drained.
+
+        ``no_recompile=True`` wraps the loop in the analysis engine's
+        :class:`~apex_tpu.analysis.program.recompile_guard`: after the
+        first (warmup) iteration, any movement of the compile-storm
+        counters raises ``AnalysisError`` — the serving loop's
+        zero-recompile contract as a live assertion instead of a test-
+        only one (the three programs are AOT-compiled at engine
+        construction, so steady-state steps must never trace)."""
+        from contextlib import nullcontext
+
+        if no_recompile:
+            from apex_tpu.analysis.program import recompile_guard
+            guard = recompile_guard("SlotScheduler.run")
+        else:
+            guard = nullcontext()
         n0 = len(self.completed)
         for r in requests:
             self.submit(r)
         steps = 0
-        while self.pending:
-            self.step()
-            steps += 1
-            if max_steps is not None and steps >= max_steps:
-                break
+        with guard:
+            while self.pending:
+                self.step()
+                steps += 1
+                if no_recompile and steps == 1:
+                    guard.rebase()  # first-dispatch host paths warmed
+                if max_steps is not None and steps >= max_steps:
+                    break
         return {c.request_id: c for c in self.completed[n0:]}

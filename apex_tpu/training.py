@@ -306,7 +306,8 @@ class GPTHybridTrainer:
                                tokens, targets)
 
     def jit_train_step(self, with_metrics: bool = False,
-                       donate: bool = True):
+                       donate: bool = True,
+                       verify_donation: bool = False):
         """``jax.jit`` of :meth:`train_step` (or
         :meth:`train_step_with_metrics`) with ``stage_stack``/``shared``/
         ``opt_state`` donated (``donate_argnums=(0, 1, 2)``): the step
@@ -331,21 +332,54 @@ class GPTHybridTrainer:
         for a constant. The ``.lower`` AOT surface is the raw jit's and
         does NOT validate — AOT callers restoring checkpoints must call
         ``trainer.opt.check_state(opt_state)`` themselves.
+
+        ``verify_donation=True`` adds the donation-annotated-entry-point
+        self-check (analysis rule ``jaxpr-donation``, docs/ANALYSIS.md)
+        on the first dispatch: the step is AOT-compiled (sharded
+        programs pair donations with outputs at XLA compile time, not at
+        lowering) and every donated leaf must appear in the compiled
+        ``input_output_alias``, with no buffer passed twice across the
+        donated arguments — raises ``AnalysisError`` otherwise. The
+        verified executable then serves every subsequent dispatch, so
+        verification costs one AOT compile total, not one extra per
+        step; requires ``donate=True`` (and, like any AOT program, the
+        argument shapes/shardings of the first call).
         """
+        if verify_donation and not donate:
+            raise ValueError("verify_donation checks the donated "
+                             "program; pass donate=True")
         fn = (self.train_step_with_metrics if with_metrics
               else self.train_step)
         jitted = jax.jit(fn, donate_argnums=(0, 1, 2) if donate else ())
-        if not self.is_zero:
+        if not self.is_zero and not verify_donation:
             return jitted
-        opt = self.opt
+        opt = self.opt if self.is_zero else None
         pending = [True]
+        impl = [jitted]
 
         def checked(stage_stack, shared, opt_state, ls, tokens, targets):
             if pending:
-                opt.check_state(opt_state)
+                if opt is not None:
+                    opt.check_state(opt_state)
+                if verify_donation:
+                    from apex_tpu.analysis.program import (
+                        check_donation, verify_findings)
+                    donated = (stage_stack, shared, opt_state)
+                    expected = sum(
+                        len(jax.tree_util.tree_leaves(t))
+                        for t in donated)
+                    compiled = jitted.lower(
+                        stage_stack, shared, opt_state, ls, tokens,
+                        targets).compile()
+                    verify_findings(check_donation(
+                        compiled, donated_args=donated,
+                        expected_donated=expected,
+                        label="GPTHybridTrainer.jit_train_step"),
+                        "GPTHybridTrainer.jit_train_step donation")
+                    impl[0] = compiled
                 pending.clear()
-            return jitted(stage_stack, shared, opt_state, ls, tokens,
-                          targets)
+            return impl[0](stage_stack, shared, opt_state, ls, tokens,
+                           targets)
 
         checked.lower = jitted.lower  # raw AOT surface (no stamp check)
         return checked
@@ -362,7 +396,7 @@ class GPTHybridTrainer:
         when ``step_time_s`` is not supplied (``iters`` timed executions
         of the freshly compiled step, donation off so the caller's state
         stays valid), and returns the
-        :class:`~apex_tpu.pyprof.attribute.AttributionReport` — markdown
+        :class:`~apex_tpu.pyprof._attribute.AttributionReport` — markdown
         via ``.markdown()``, JSONL via ``.json_lines()``, and the
         ``perf/*`` gauges via ``StepReporter.attach_attribution``.
         ``trace_dir``/``spans`` upgrade the exposure accounting from

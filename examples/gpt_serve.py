@@ -64,16 +64,27 @@ def main(argv=None):
                                                  * (i + 1)) // 2,
                              temperature=0.0 if i % 2 == 0 else 0.8))
 
+    # the steady-state loop runs under the analysis engine's
+    # zero-recompile guard (docs/ANALYSIS.md): after the first (warmup)
+    # step, any retrace of the serving programs raises loudly
+    from apex_tpu.analysis import recompile_guard
+
     seen = {}
-    while sched.pending:
-        sched.step()
-        # stream: print each request's tokens as they extend
-        for slot, st in sched.active.items():
-            rid = st.request.request_id
-            if len(st.generated) != seen.get(rid):
-                seen[rid] = len(st.generated)
-                print(f"  req {rid} (slot {slot}): "
-                      f"{st.generated[-4:]} ({len(st.generated)} tokens)")
+    steps = 0
+    with recompile_guard("gpt_serve loop") as guard:
+        while sched.pending:
+            sched.step()
+            steps += 1
+            if steps == 1:
+                guard.rebase()
+            # stream: print each request's tokens as they extend
+            for slot, st in sched.active.items():
+                rid = st.request.request_id
+                if len(st.generated) != seen.get(rid):
+                    seen[rid] = len(st.generated)
+                    print(f"  req {rid} (slot {slot}): "
+                          f"{st.generated[-4:]} "
+                          f"({len(st.generated)} tokens)")
 
     results = {c.request_id: c for c in sched.completed}
     for rid in sorted(results):

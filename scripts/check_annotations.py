@@ -1,20 +1,13 @@
 #!/usr/bin/env python
-"""Static check: the documented hot-path ``jax.named_scope`` annotations
-still exist in source.
-
-The annotate -> trace -> attribute workflow (``utils/timers.py`` module
-docstring, ``docs/OBSERVABILITY.md``) depends on four names showing up in
-HLO op metadata so captured profiles stay attributable; a refactor that
-drops one silently rots the trace-viewer contract. This script greps the
-exact ``named_scope("<name>")`` strings out of the owning sources — no jax
-import, so it runs anywhere, pre-commit fast — and exits non-zero listing
-anything missing. Wired into the test suite via
-``tests/test_observability.py::test_check_annotations_script``.
-
-Usage::
+"""Shim: the named_scope annotation contract moved into the unified
+static-analysis engine (``apex_tpu.analysis``, rule ``ast-annotations``;
+table: ``ANNOTATIONS`` in ``apex_tpu/analysis/rules_ast.py``, docs:
+``docs/ANALYSIS.md``). This script keeps the historical CLI +
+``check(repo) -> (ok, lines)`` surface::
 
     python scripts/check_annotations.py          # check, report, exit 0/1
     python scripts/check_annotations.py --list   # print the contract
+    python -m apex_tpu.analysis --rule ast-annotations   # same rule
 """
 
 from __future__ import annotations
@@ -22,68 +15,19 @@ from __future__ import annotations
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-# annotation -> source files allowed to carry it (repo-relative). The
-# contract is "exists in at least one of its owning files": moving an
-# annotation to an unrelated module is a docs-breaking change and should
-# fail here until the table (and docs) are updated. The table doubles as
-# the pyprof attribution-region vocabulary: apex_tpu/pyprof/model.py's
-# DEFAULT_REGIONS must stay a subset of these keys (asserted in
-# tests/test_pyprof.py), so every region a step-time attribution report
-# names is guaranteed to exist as a named_scope in source.
-ANNOTATIONS = {
-    "apex_ddp_allreduce": ["apex_tpu/parallel/distributed.py"],
-    "apex_ddp_bucketed_allreduce": ["apex_tpu/parallel/distributed.py"],
-    "sync_bn_stats": ["apex_tpu/parallel/sync_batchnorm.py"],
-    "pipeline_tick": [
-        "apex_tpu/transformer/pipeline_parallel/schedules.py"],
-    "flash_attention": ["apex_tpu/ops/flash_attention.py"],
-    "optimizer_step": ["apex_tpu/optimizers/_base.py"],
-    # model phases (pyprof attribution regions)
-    "gpt_embed": ["apex_tpu/models/gpt.py"],
-    "gpt_ln": ["apex_tpu/models/gpt.py"],
-    "gpt_attention": ["apex_tpu/models/gpt.py"],
-    "gpt_mlp": ["apex_tpu/models/gpt.py"],
-    "gpt_head_loss": ["apex_tpu/models/gpt.py"],
-    "rn50_stem": ["apex_tpu/models/resnet.py"],
-    "rn50_body": ["apex_tpu/models/resnet.py"],
-    "rn50_head": ["apex_tpu/models/resnet.py"],
-    # tensor-parallel layers (GEMM + dependent collective, tp > 1 only)
-    "tp_column_linear": [
-        "apex_tpu/transformer/tensor_parallel/layers.py"],
-    "tp_row_linear": [
-        "apex_tpu/transformer/tensor_parallel/layers.py"],
-    # serving fast path: the decode kernel plus the two AOT step bodies,
-    # so pyprof attributes prefill vs decode (docs/SERVING.md)
-    "decode_attention": ["apex_tpu/ops/flash_attention.py"],
-    "serve_prefill": ["apex_tpu/serving/engine.py"],
-    "serve_decode": ["apex_tpu/serving/engine.py"],
-}
+from apex_tpu.analysis.astlint import repo_root
+from apex_tpu.analysis.core import findings_to_ok_lines
+from apex_tpu.analysis.rules_ast import ANNOTATIONS, rule_annotations
+
+REPO = repo_root()
 
 
 def check(repo: str = REPO):
     """Returns (ok, report_lines)."""
-    lines = []
-    ok = True
-    for name, files in sorted(ANNOTATIONS.items()):
-        needle = f'named_scope("{name}")'
-        found_in = []
-        for rel in files:
-            path = os.path.join(repo, rel)
-            try:
-                with open(path) as f:
-                    if needle in f.read():
-                        found_in.append(rel)
-            except OSError:
-                pass
-        if found_in:
-            lines.append(f"ok       {name}: {', '.join(found_in)}")
-        else:
-            ok = False
-            lines.append(f"MISSING  {name}: expected "
-                         f'{needle} in {" or ".join(files)}')
-    return ok, lines
+    return findings_to_ok_lines(*rule_annotations(repo))
 
 
 def main(argv=None) -> int:
@@ -97,8 +41,9 @@ def main(argv=None) -> int:
         print(line)
     if not ok:
         print("hot-path trace annotations missing — update the source or "
-              "the contract table in scripts/check_annotations.py + "
-              "docs/OBSERVABILITY.md", file=sys.stderr)
+              "the contract table (ANNOTATIONS in "
+              "apex_tpu/analysis/rules_ast.py) + docs/OBSERVABILITY.md",
+              file=sys.stderr)
     return 0 if ok else 1
 
 

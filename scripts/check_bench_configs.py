@@ -1,198 +1,41 @@
 #!/usr/bin/env python
-"""Static check: every bench-config key names a real config field.
-
-The trainer-driven bench legs are built from the declarative
-``BENCH_TRAIN_CONFIGS`` table in ``bench.py`` (TrainConfig-shaped nested
-dicts), and emitted bench lines may carry a ``config`` block recording
-the resolved knobs into ``BENCH_CONFIGS.json``. Both are *data*, so a
-renamed dataclass field would not fail at import time — a stale key in a
-from-dict path can silently fall a leg back to defaults and the bench
-would keep printing numbers for a configuration it no longer runs. This
-script AST-walks the config dataclasses (``apex_tpu/config.py``:
-TrainConfig/ModelConfig/ParallelConfig/BatchConfig/OptimizerConfig, and
-``apex_tpu/models/gpt.py``: GPTConfig — no jax import, pre-commit fast)
-and validates:
-
-- every key in ``bench.py``'s ``BENCH_TRAIN_CONFIGS`` legs (top level
-  against TrainConfig, nested ``model``/``parallel``/``batch``/
-  ``optimizer`` sections against their dataclasses);
-- every ``config`` block inside ``BENCH_CONFIGS.json`` entries, same
-  rule (the emitted record must stay replayable through
-  ``TrainConfig.from_dict``);
-- every literal keyword at ``_gpt_train_step(...)`` call sites in
-  ``bench.py`` against the function's own parameters plus GPTConfig
-  fields (the ``cfg_overrides`` passthrough).
-
-Wired into the test suite via
-``tests/test_observability.py::TestCheckBenchConfigs``. Exits non-zero
-listing every unknown key.
-
-Usage::
+"""Shim: the bench-config field contract moved into the unified
+static-analysis engine (``apex_tpu.analysis``, rule
+``ast-bench-configs``; field tables come from the config dataclasses via
+``bench_field_tables`` in ``apex_tpu/analysis/rules_ast.py``, docs:
+``docs/ANALYSIS.md``). Historical CLI preserved::
 
     python scripts/check_bench_configs.py          # check, report, exit 0/1
     python scripts/check_bench_configs.py --list   # print the field tables
+    python -m apex_tpu.analysis --rule ast-bench-configs   # same rule
 """
 
 from __future__ import annotations
 
-import ast
-import json
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-CONFIG_CLASSES = ("TrainConfig", "ModelConfig", "ParallelConfig",
-                  "BatchConfig", "OptimizerConfig")
-SECTIONS = {"model": "ModelConfig", "parallel": "ParallelConfig",
-            "batch": "BatchConfig", "optimizer": "OptimizerConfig"}
+from apex_tpu.analysis.astlint import repo_root
+from apex_tpu.analysis.core import findings_to_ok_lines
+from apex_tpu.analysis.rules_ast import (CONFIG_CLASSES, SECTIONS,  # noqa: F401
+                                         bench_field_tables as field_tables,
+                                         rule_bench_configs)
 
-
-def _dataclass_fields(path: str, class_names) -> dict:
-    """``{class_name: {field, ...}}`` from annotated class-body
-    assignments (the dataclass field syntax), no import needed."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    out = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef) and node.name in class_names:
-            fields = set()
-            for stmt in node.body:
-                if isinstance(stmt, ast.AnnAssign) \
-                        and isinstance(stmt.target, ast.Name):
-                    fields.add(stmt.target.id)
-            out[node.name] = fields
-    return out
-
-
-def field_tables(repo: str = REPO) -> dict:
-    tables = _dataclass_fields(
-        os.path.join(repo, "apex_tpu", "config.py"), CONFIG_CLASSES)
-    tables.update(_dataclass_fields(
-        os.path.join(repo, "apex_tpu", "models", "gpt.py"), ("GPTConfig",)))
-    missing = [c for c in (*CONFIG_CLASSES, "GPTConfig")
-               if not tables.get(c)]
-    if missing:
-        raise ValueError(f"could not extract fields for {missing}")
-    return tables
-
-
-def _check_spec(spec: dict, tables: dict, where: str, lines: list) -> bool:
-    """One TrainConfig-shaped nested dict against the field tables."""
-    ok = True
-    for key, value in spec.items():
-        if key not in tables["TrainConfig"]:
-            ok = False
-            lines.append(f"UNKNOWN  {where}: {key!r} is not a "
-                         f"TrainConfig field")
-            continue
-        section = SECTIONS.get(key)
-        if section and isinstance(value, dict):
-            for sub in value:
-                if sub not in tables[section]:
-                    ok = False
-                    lines.append(f"UNKNOWN  {where}: {key}.{sub!r} is "
-                                 f"not a {section} field")
-    return ok
-
-
-def _bench_table(bench_path: str):
-    """The literal ``BENCH_TRAIN_CONFIGS`` dict from bench.py, or None."""
-    with open(bench_path) as f:
-        tree = ast.parse(f.read(), filename=bench_path)
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign):
-            for target in node.targets:
-                if isinstance(target, ast.Name) \
-                        and target.id == "BENCH_TRAIN_CONFIGS":
-                    return ast.literal_eval(node.value)
-    return None
-
-
-def _gpt_step_calls(bench_path: str):
-    """``(lineno, kw_names)`` of every ``_gpt_train_step(...)`` call,
-    plus the def's own parameter names."""
-    with open(bench_path) as f:
-        tree = ast.parse(f.read(), filename=bench_path)
-    own_params = set()
-    calls = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.FunctionDef) \
-                and node.name == "_gpt_train_step":
-            a = node.args
-            own_params = {p.arg for p in
-                          (*a.posonlyargs, *a.args, *a.kwonlyargs)}
-        if isinstance(node, ast.Call):
-            fn = node.func
-            name = (fn.id if isinstance(fn, ast.Name)
-                    else fn.attr if isinstance(fn, ast.Attribute) else None)
-            if name == "_gpt_train_step":
-                kws = [k.arg for k in node.keywords if k.arg is not None]
-                calls.append((node.lineno, kws))
-    return own_params, calls
+REPO = repo_root()
 
 
 def check(repo: str = REPO):
-    """Returns ``(ok, report_lines)``."""
-    lines, ok = [], True
-    try:
-        tables = field_tables(repo)
-    except (OSError, ValueError) as e:
-        return False, [f"MISSING  config field tables: {e}"]
-
-    bench_path = os.path.join(repo, "bench.py")
-    try:
-        table = _bench_table(bench_path)
-        own_params, calls = _gpt_step_calls(bench_path)
-    except (OSError, SyntaxError, ValueError) as e:
-        return False, [f"MISSING  bench.py: {e}"]
-    if table is None:
-        ok = False
-        lines.append("MISSING  bench.py: no literal BENCH_TRAIN_CONFIGS "
-                     "table")
-    else:
-        for leg, spec in table.items():
-            where = f"bench.py BENCH_TRAIN_CONFIGS[{leg!r}]"
-            if _check_spec(spec, tables, where, lines):
-                lines.append(f"ok       {where}: "
-                             f"{sum(len(v) if isinstance(v, dict) else 1 for v in spec.values())} keys")
-            else:
-                ok = False
-
-    allowed = own_params | tables["GPTConfig"]
-    for lineno, kws in calls:
-        bad = [k for k in kws if k not in allowed]
-        if bad:
-            ok = False
-            lines.append(f"UNKNOWN  bench.py:{lineno} _gpt_train_step "
-                         f"keyword(s) {bad} match neither its parameters "
-                         f"nor a GPTConfig field")
-        else:
-            lines.append(f"ok       bench.py:{lineno} _gpt_train_step call")
-
-    results_path = os.path.join(repo, "BENCH_CONFIGS.json")
-    if os.path.exists(results_path):
-        try:
-            with open(results_path) as f:
-                entries = json.load(f)
-        except (OSError, ValueError) as e:
-            return False, lines + [f"MISSING  BENCH_CONFIGS.json: {e}"]
-        for entry in entries if isinstance(entries, list) else []:
-            cfg = entry.get("config") if isinstance(entry, dict) else None
-            if isinstance(cfg, dict):
-                where = (f"BENCH_CONFIGS.json "
-                         f"[{entry.get('metric', '?')}].config")
-                if not _check_spec(cfg, tables, where, lines):
-                    ok = False
-                else:
-                    lines.append(f"ok       {where}")
-    return ok, lines
+    """Returns (ok, report_lines)."""
+    return findings_to_ok_lines(*rule_bench_configs(repo))
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if "--list" in argv:
-        for cls, fields in sorted(field_tables().items()):
+        for cls, fields in sorted(field_tables(REPO).items()):
             print(f"{cls}: {', '.join(sorted(fields))}")
         return 0
     ok, lines = check()

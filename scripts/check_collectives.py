@@ -1,141 +1,37 @@
 #!/usr/bin/env python
-"""Static check: collectives stay behind their chokepoints.
-
-Two routing contracts, one fast grep (no jax import, pre-commit fast),
-wired into the test suite via
-``tests/test_observability.py::TestCheckCollectives``:
-
-1. **Gathers** — the one collective whose semantics changed across the jax
-   version line this library straddles: on VMA jax ``all_gather`` demands a
-   device-varying operand (a replicated-typed value must be ``pcast``
-   first) and there is a separate invariant-typed gather, while on the
-   pre-VMA 0.4.x line neither concept exists. ``apex_tpu.utils.vma`` owns
-   both shims (:func:`varying_all_gather`, :func:`invariant_all_gather`);
-   a raw ``jax.lax.all_gather`` sprinkled anywhere else silently works on
-   one version and breaks on the other.
-
-2. **Gradient syncs** — ``apex_tpu.parallel.distributed`` is the bucketing
-   engine: every DP grad reduction must flow through
-   :func:`allreduce_grads` / :func:`grouped_psum` /
-   :func:`reduce_scatter_grads` so ``bucket_bytes`` policy, telemetry
-   (``ddp/*``), and the health watchdog see it. Raw ``lax.psum_scatter``
-   is flagged package-wide outside the chokepoint module (the only other
-   legitimate holder is the context-parallel *activation* scatter, which
-   is not a grad sync and is allowlisted); raw ``lax.psum`` /
-   ``lax.psum_scatter`` are flagged inside the grad-handling modules
-   (``training.py``, ``optimizers/``), where any psum IS a grad-path
-   reduction or belongs in the chokepoint anyway.
-
-Usage::
+"""Shim: the collective-routing contract moved into the unified
+static-analysis engine (``apex_tpu.analysis``, rule ``ast-collectives``;
+allowlists: ``ALLOWED_GATHER``/``ALLOWED_SCATTER``/``GRAD_SYNC_PREFIXES``
+in ``apex_tpu/analysis/rules_ast.py``, docs: ``docs/ANALYSIS.md``). The
+program-level twin — which also catches a helper that reaches
+``lax.psum`` through indirection — is the ``jaxpr-collectives`` rule.
+Historical CLI preserved::
 
     python scripts/check_collectives.py          # check, report, exit 0/1
     python scripts/check_collectives.py --list   # print the policy
+    python -m apex_tpu.analysis --rule ast-collectives   # same rule
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = "apex_tpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
+from apex_tpu.analysis.astlint import repo_root
+from apex_tpu.analysis.core import findings_to_ok_lines
+from apex_tpu.analysis.rules_ast import (ALLOWED_GATHER, ALLOWED_SCATTER,
+                                         GRAD_SYNC_PREFIXES,
+                                         rule_collectives)
 
-def _p(*parts: str) -> str:
-    return os.path.join(*parts)
-
-
-# the only modules allowed to touch lax.all_gather directly: the VMA shims
-# themselves and the version-compat layer
-ALLOWED_GATHER = {
-    _p("apex_tpu", "utils", "vma.py"),
-    _p("apex_tpu", "utils", "compat.py"),
-}
-
-# lax.psum_scatter: the grad-sync chokepoint (reduce_scatter_grads), plus
-# the context-parallel sequence-dim scatter — an ACTIVATION collective
-# (RowParallel output path along the sequence axis), not a gradient sync,
-# so it does not belong behind the bucketing engine
-ALLOWED_SCATTER = {
-    _p("apex_tpu", "parallel", "distributed.py"),
-    _p("apex_tpu", "transformer", "context_parallel.py"),
-}
-
-# modules whose psums are gradient-path reductions by construction: any
-# raw lax.psum / lax.psum_scatter here must route through the
-# parallel/distributed.py chokepoints (allreduce_grads / grouped_psum /
-# reduce_scatter_grads) so bucketing policy cannot be bypassed
-GRAD_SYNC_PREFIXES = (
-    _p("apex_tpu", "training.py"),
-    _p("apex_tpu", "optimizers") + os.sep,
-)
-
-_GATHER = re.compile(r"lax\.all_gather\s*\(")
-_SCATTER = re.compile(r"lax\.psum_scatter\s*\(")
-_PSUM = re.compile(r"lax\.psum\s*\(")
-
-
-def _hits(pattern: re.Pattern, source: str):
-    return [i + 1 for i, line in enumerate(source.splitlines())
-            if pattern.search(line)]
+REPO = repo_root()
 
 
 def check(repo: str = REPO):
     """Returns (ok, report_lines)."""
-    lines = []
-    ok = True
-    pkg_root = os.path.join(repo, PACKAGE)
-    for dirpath, _dirnames, filenames in sorted(os.walk(pkg_root)):
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, repo)
-            with open(path) as f:
-                source = f.read()
-
-            hits = _hits(_GATHER, source)
-            if hits:
-                if rel in ALLOWED_GATHER:
-                    lines.append(f"ok       {rel}: gather wrapper module "
-                                 f"(lines {', '.join(map(str, hits))})")
-                else:
-                    ok = False
-                    for ln in hits:
-                        lines.append(
-                            f"RAW      {rel}:{ln}: lax.all_gather outside "
-                            f"the VMA-safe wrappers — use "
-                            f"apex_tpu.utils.vma.varying_all_gather (or "
-                            f"invariant_all_gather)")
-
-            hits = _hits(_SCATTER, source)
-            if hits:
-                if rel in ALLOWED_SCATTER:
-                    lines.append(f"ok       {rel}: psum_scatter chokepoint/"
-                                 f"allowlisted "
-                                 f"(lines {', '.join(map(str, hits))})")
-                else:
-                    ok = False
-                    for ln in hits:
-                        lines.append(
-                            f"RAW      {rel}:{ln}: lax.psum_scatter outside "
-                            f"the grad-sync chokepoint — use apex_tpu."
-                            f"parallel.distributed.reduce_scatter_grads "
-                            f"(bucketing/telemetry ride on it)")
-
-            if rel.startswith(GRAD_SYNC_PREFIXES):
-                psum_hits = _hits(_PSUM, source)
-                if psum_hits:
-                    ok = False
-                    for ln in psum_hits:
-                        lines.append(
-                            f"RAW      {rel}:{ln}: raw lax.psum in a "
-                            f"grad-sync module — route through apex_tpu."
-                            f"parallel.distributed (allreduce_grads / "
-                            f"grouped_psum) so bucketing policy and ddp/* "
-                            f"telemetry cannot be bypassed")
-    return ok, lines
+    return findings_to_ok_lines(*rule_collectives(repo))
 
 
 def main(argv=None) -> int:
@@ -158,7 +54,7 @@ def main(argv=None) -> int:
         print("raw collective call sites found — route gathers through "
               "apex_tpu/utils/vma.py and grad syncs through "
               "apex_tpu/parallel/distributed.py (or extend the allowlists "
-              "in scripts/check_collectives.py with justification)",
+              "in apex_tpu/analysis/rules_ast.py with justification)",
               file=sys.stderr)
     return 0 if ok else 1
 

@@ -1,115 +1,34 @@
 #!/usr/bin/env python
-"""Static check: elastic code never exits the process on its own.
-
-The elastic runtime's exit discipline is a contract: the ONLY way a
-training process terminates deliberately is
-``AutoResume.request_resume`` (exit 0 inside the preemption grace
-window, so the scheduler restarts the job). Any other ``sys.exit`` /
-``os._exit`` / builtin ``exit``/``quit`` / ``raise SystemExit`` under
-``apex_tpu/elastic/`` would make a failure indistinguishable from a
-clean preemption — failures must PROPAGATE as exceptions. This script
-AST-walks the elastic package and flags every process-exit spelling; it
-also verifies the chokepoint itself still exists (exactly one
-``sys.exit``, inside ``AutoResume.request_resume`` in
-``apex_tpu/utils/autoresume.py``) so the rule cannot rot silently.
-
-No jax import, pre-commit fast; exits non-zero listing every violation.
-Wired into the suite via
-``tests/test_observability.py::TestCheckElasticExits``.
-
-Usage::
+"""Shim: the elastic exit-discipline contract moved into the unified
+static-analysis engine (``apex_tpu.analysis``, rule
+``ast-elastic-exits``; chokepoint anchors: ``CHOKEPOINT_FILE``/
+``CHOKEPOINT_FUNC`` in ``apex_tpu/analysis/rules_ast.py``, docs:
+``docs/ANALYSIS.md``). Historical CLI preserved::
 
     python scripts/check_elastic_exits.py          # check, report, 0/1
+    python -m apex_tpu.analysis --rule ast-elastic-exits   # same rule
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-ELASTIC_DIR = os.path.join("apex_tpu", "elastic")
-CHOKEPOINT_FILE = os.path.join("apex_tpu", "utils", "autoresume.py")
-CHOKEPOINT_FUNC = "request_resume"
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
+from apex_tpu.analysis.astlint import repo_root
+from apex_tpu.analysis.core import findings_to_ok_lines
+from apex_tpu.analysis.rules_ast import (CHOKEPOINT_FILE,  # noqa: F401
+                                         CHOKEPOINT_FUNC, ELASTIC_DIR,
+                                         rule_elastic_exits)
 
-def _exit_spelling(node) -> str | None:
-    """The process-exit spelling of an AST node, or None."""
-    if isinstance(node, ast.Call):
-        f = node.func
-        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
-            if (f.value.id, f.attr) in (("sys", "exit"), ("os", "_exit"),
-                                        ("os", "abort")):
-                return f"{f.value.id}.{f.attr}"
-        if isinstance(f, ast.Name) and f.id in ("exit", "quit"):
-            return f.id
-    if isinstance(node, ast.Raise) and node.exc is not None:
-        exc = node.exc
-        name = (exc.func if isinstance(exc, ast.Call) else exc)
-        if isinstance(name, ast.Name) and name.id == "SystemExit":
-            return "raise SystemExit"
-    return None
-
-
-def _iter_py(root: str):
-    for dirpath, _dirnames, filenames in sorted(os.walk(root)):
-        for fname in sorted(filenames):
-            if fname.endswith(".py"):
-                yield os.path.join(dirpath, fname)
+REPO = repo_root()
 
 
 def check(repo: str = REPO):
-    """Returns ``(ok, report_lines)``."""
-    lines, ok = [], True
-
-    pkg = os.path.join(repo, ELASTIC_DIR)
-    if not os.path.isdir(pkg):
-        return False, [f"MISSING  {ELASTIC_DIR}: elastic package absent"]
-    for path in _iter_py(pkg):
-        rel = os.path.relpath(path, repo)
-        with open(path) as f:
-            try:
-                tree = ast.parse(f.read(), filename=rel)
-            except SyntaxError:
-                continue
-        clean = True
-        for node in ast.walk(tree):
-            spelling = _exit_spelling(node)
-            if spelling is not None:
-                ok = clean = False
-                lines.append(
-                    f"EXIT     {spelling} ({rel}:{node.lineno}): elastic "
-                    f"code must exit only through AutoResume."
-                    f"{CHOKEPOINT_FUNC} — raise instead, so failures "
-                    f"stay distinguishable from clean preemptions")
-        if clean:
-            lines.append(f"ok       {rel}")
-
-    # the chokepoint itself: exactly one sys.exit, inside request_resume
-    choke = os.path.join(repo, CHOKEPOINT_FILE)
-    try:
-        with open(choke) as f:
-            tree = ast.parse(f.read(), filename=CHOKEPOINT_FILE)
-    except OSError:
-        return False, lines + [
-            f"MISSING  {CHOKEPOINT_FILE}: the AutoResume chokepoint the "
-            f"contract is anchored on cannot be read"]
-    exits = []
-    for func in [n for n in ast.walk(tree)
-                 if isinstance(n, ast.FunctionDef)]:
-        for node in ast.walk(func):
-            if _exit_spelling(node) == "sys.exit":
-                exits.append(func.name)
-    if exits != [CHOKEPOINT_FUNC]:
-        ok = False
-        lines.append(
-            f"CHOKE    {CHOKEPOINT_FILE}: expected exactly one sys.exit "
-            f"inside {CHOKEPOINT_FUNC}, found {exits or 'none'}")
-    else:
-        lines.append(f"ok       {CHOKEPOINT_FILE}::{CHOKEPOINT_FUNC} is "
-                     f"the sole exit chokepoint")
-    return ok, lines
+    """Returns (ok, report_lines)."""
+    return findings_to_ok_lines(*rule_elastic_exits(repo))
 
 
 def main(argv=None) -> int:

@@ -1,146 +1,60 @@
 #!/usr/bin/env python
-"""Static check: every in-graph metric recorded in source is documented.
-
-The per-step metric families (``health/*``, ``tp/*``, ``amp/*``,
-``ddp/*``, ``pipeline/*``, ``optim/*``, ``zero/*``, ``mem/*``,
-``perf/*``) are a public contract — dashboards
-and the crash-dump post-mortem workflow key on the names — and the
-contract lives in the docs/OBSERVABILITY.md table. A ``record()`` call
-added without a doc row silently grows an undocumented surface; this
-script AST-walks the package for ``record(...)`` call sites — and
-``gauge(...)`` call sites, the host-registry half the ``mem/*`` family
-lives on — extracts the
-metric-name first argument (plain string literals, and f-strings whose
-formatted fields normalize to a ``<>`` placeholder — ``f"health/{name}/l2"``
-checks as ``health/<>/l2``), and requires each name in a checked family to
-appear in backticks somewhere in the doc (doc placeholders like
-``<tree>`` normalize the same way). No jax import, pre-commit fast; exits
-non-zero listing every undocumented name. Wired into the test suite via
-``tests/test_observability.py::TestCheckMetricsDoc``.
-
-Usage::
+"""Shim: the metric-name documentation contract moved into the unified
+static-analysis engine (``apex_tpu.analysis``, rules ``ast-metrics-doc``
++ the ``ast-metric-families`` meta-lint; family list:
+``METRIC_PREFIXES`` in ``apex_tpu/analysis/rules_ast.py``, docs:
+``docs/ANALYSIS.md``). Running this shim checks BOTH: per-name doc rows
+for the checked families, and — new — that no call site opens a metric
+family outside the registered list at all (the list used to be grown by
+hand per PR). Historical CLI preserved::
 
     python scripts/check_metrics_doc.py          # check, report, exit 0/1
     python scripts/check_metrics_doc.py --list   # print recorded names
+    python -m apex_tpu.analysis --rule ast-metrics-doc
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = "apex_tpu"
-DOC = os.path.join("docs", "OBSERVABILITY.md")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-# metric families under the documentation contract; names outside these
-# prefixes (host registry internals, ad-hoc example metrics) are exempt
-PREFIXES = ("health/", "tp/", "amp/", "ddp/", "pipeline/", "optim/",
-            "zero/", "mem/", "perf/", "ckpt/", "resume/", "serve/")
+from apex_tpu.analysis.astlint import repo_root
+from apex_tpu.analysis.core import findings_to_ok_lines
+from apex_tpu.analysis.rules_ast import (METRIC_CALLEES as CALLEES,  # noqa: F401
+                                         METRIC_PREFIXES as PREFIXES,
+                                         _metric_names,
+                                         rule_metric_families,
+                                         rule_metrics_doc)
 
-# callees whose literal first argument is a metric name: in-graph
-# ``ingraph.record(...)`` and the host-registry accessors — ``gauge``
-# (the mem/* family is static per compile, so it rides gauges, not
-# records) plus ``counter``/``histogram``, which the elastic runtime's
-# ckpt/* and resume/* families ride
-CALLEES = ("record", "gauge", "counter", "histogram")
-
-_PLACEHOLDER = re.compile(r"<[^<>`]*>")
-
-
-def _norm(name: str) -> str:
-    """Collapse every ``<...>`` placeholder spelling to ``<>`` so the
-    source's ``f"health/{name}/l2"`` matches the doc's
-    ``health/<tree>/l2``."""
-    return _PLACEHOLDER.sub("<>", name)
-
-
-def _literal_name(node) -> str | None:
-    """The metric-name string of a ``record()`` first argument, with
-    f-string fields as ``<>`` — None when it is not statically known."""
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value
-    if isinstance(node, ast.JoinedStr):
-        parts = []
-        for piece in node.values:
-            if isinstance(piece, ast.Constant):
-                parts.append(str(piece.value))
-            else:  # FormattedValue
-                parts.append("<>")
-        return "".join(parts)
-    return None
-
-
-def recorded_names(repo: str = REPO):
-    """Yield ``(relpath, lineno, name)`` for every ``record(...)`` /
-    ``gauge(...)`` metric name in the package that falls under a checked
-    prefix."""
-    pkg_root = os.path.join(repo, PACKAGE)
-    for dirpath, _dirnames, filenames in sorted(os.walk(pkg_root)):
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, repo)
-            with open(path) as f:
-                try:
-                    tree = ast.parse(f.read(), filename=rel)
-                except SyntaxError:
-                    continue
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call) or not node.args:
-                    continue
-                func = node.func
-                callee = (func.id if isinstance(func, ast.Name)
-                          else func.attr if isinstance(func, ast.Attribute)
-                          else None)
-                if callee not in CALLEES:
-                    continue
-                name = _literal_name(node.args[0])
-                if name is not None and _norm(name).startswith(PREFIXES):
-                    yield rel, node.lineno, name
-
-
-def documented_names(repo: str = REPO) -> set:
-    """Every backticked token in the observability doc, normalized."""
-    with open(os.path.join(repo, DOC)) as f:
-        text = f.read()
-    return {_norm(tok) for tok in re.findall(r"`([^`\n]+)`", text)}
+REPO = repo_root()
 
 
 def check(repo: str = REPO):
-    """Returns (ok, report_lines)."""
-    try:
-        documented = documented_names(repo)
-    except OSError:
-        return False, [f"MISSING  {DOC}: cannot read the metric table"]
-    lines, ok = [], True
-    for rel, lineno, name in recorded_names(repo):
-        if _norm(name) in documented:
-            lines.append(f"ok       {name} ({rel}:{lineno})")
-        else:
-            ok = False
-            lines.append(f"UNDOC    {name} ({rel}:{lineno}): recorded but "
-                         f"absent from {DOC}")
-    return ok, lines
+    """Returns (ok, report_lines) — the doc-row check plus the
+    family meta-lint."""
+    doc_f, doc_n = rule_metrics_doc(repo)
+    fam_f, fam_n = rule_metric_families(repo)
+    return findings_to_ok_lines(doc_f + fam_f, doc_n + fam_n)
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if "--list" in argv:
-        for rel, lineno, name in recorded_names():
+        for rel, lineno, name in _metric_names(REPO):
             print(f"{name}\t{rel}:{lineno}")
         return 0
     ok, lines = check()
     for line in lines:
         print(line)
     if not ok:
-        print("undocumented metrics found — add rows to the "
-              "docs/OBSERVABILITY.md table (placeholders like <tree> "
-              "match f-string fields) or rename outside the checked "
-              "families in scripts/check_metrics_doc.py", file=sys.stderr)
+        print("undocumented metrics (or an unregistered metric family) "
+              "found — add rows to the docs/OBSERVABILITY.md table "
+              "(placeholders like <tree> match f-string fields) and "
+              "register new families in METRIC_PREFIXES "
+              "(apex_tpu/analysis/rules_ast.py)", file=sys.stderr)
     return 0 if ok else 1
 
 

@@ -1,145 +1,44 @@
 #!/usr/bin/env python
-"""Static check: every ``checkpoint_name`` tag literal comes from the
-central registry.
-
-The activation-remat policies (``apex_tpu/remat.py``) address activations
-by name: ``save_only_these_names`` / ``save_and_offload_only_these_names``
-save exactly the tags the models emit. A tag literal outside
-``remat.CHECKPOINT_NAMES`` is an orphan — no policy can reach it, and a
-save-list naming it would pass ``RematPolicy`` validation against a
-registry that doesn't know the activation exists. ``remat.tag`` validates
-at trace time; this script catches the same class *statically* (including
-raw ``jax.ad_checkpoint.checkpoint_name`` calls that bypass the
-chokepoint), no jax import, pre-commit fast.
-
-It AST-walks the package for calls whose callee is ``checkpoint_name``,
-``tag`` or a ``_tag`` method (the models' policy-gated tagger) with a
-string-literal name in the second argument, parses the registry tuple out
-of ``apex_tpu/remat.py`` (also statically), and exits non-zero listing
-every literal not in the registry — plus any ``SELECTIVE_SAVE`` entry
-missing from ``CHECKPOINT_NAMES`` (the save-list must be a registry
-subset). Wired into the test suite via
-``tests/test_observability.py::TestCheckRematNames``.
-
-Usage::
+"""Shim: the checkpoint-name registry contract moved into the unified
+static-analysis engine (``apex_tpu.analysis``, rule ``ast-remat-names``;
+tag spellings: ``TAG_CALLEES`` in ``apex_tpu/analysis/rules_ast.py``,
+docs: ``docs/ANALYSIS.md``). Historical CLI preserved::
 
     python scripts/check_remat_names.py          # check, report, exit 0/1
     python scripts/check_remat_names.py --list   # print tag sites + registry
+    python -m apex_tpu.analysis --rule ast-remat-names   # same rule
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = "apex_tpu"
-REGISTRY_FILE = os.path.join(PACKAGE, "remat.py")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-# callee spellings that denote a checkpoint-name tag. ``_tag`` is the
-# models' policy-gated bound tagger (identity under none/full); ``tag``
-# the remat-module chokepoint; ``checkpoint_name`` the raw jax call.
-TAG_CALLEES = ("checkpoint_name", "tag", "_tag", "_remat_tag")
+from apex_tpu.analysis.astlint import repo_root
+from apex_tpu.analysis.core import findings_to_ok_lines
+from apex_tpu.analysis.rules_ast import (REGISTRY_FILE, TAG_CALLEES,  # noqa: F401
+                                         _remat_registry as registry,
+                                         _tag_sites as tag_sites,
+                                         rule_remat_names)
 
-
-def _tuple_literal(node) -> list:
-    if isinstance(node, (ast.Tuple, ast.List)):
-        return [e.value for e in node.elts
-                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
-    return []
-
-
-def registry(repo: str = REPO):
-    """``(CHECKPOINT_NAMES, SELECTIVE_SAVE)`` parsed from the registry
-    module's AST — raises OSError/ValueError when the module or the
-    assignments are missing (a moved registry must move this scan too)."""
-    with open(os.path.join(repo, REGISTRY_FILE)) as f:
-        tree = ast.parse(f.read(), filename=REGISTRY_FILE)
-    names = save = None
-    for node in ast.walk(tree):
-        targets = ()
-        if isinstance(node, ast.Assign):
-            targets = node.targets
-        elif isinstance(node, ast.AnnAssign) and node.value is not None:
-            targets = (node.target,)
-        for t in targets:
-            if isinstance(t, ast.Name) and t.id == "CHECKPOINT_NAMES":
-                names = _tuple_literal(node.value)
-            if isinstance(t, ast.Name) and t.id == "SELECTIVE_SAVE":
-                save = _tuple_literal(node.value)
-    if not names:
-        raise ValueError(
-            f"{REGISTRY_FILE} defines no CHECKPOINT_NAMES tuple literal")
-    return tuple(names), tuple(save or ())
-
-
-def tag_sites(repo: str = REPO):
-    """Yield ``(relpath, lineno, name)`` for every statically-known tag
-    literal in the package (registry module excluded — its docstrings and
-    error messages mention names by design)."""
-    pkg_root = os.path.join(repo, PACKAGE)
-    for dirpath, _dirnames, filenames in sorted(os.walk(pkg_root)):
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, repo)
-            if rel == REGISTRY_FILE:
-                continue
-            with open(path) as f:
-                try:
-                    tree = ast.parse(f.read(), filename=rel)
-                except SyntaxError:
-                    continue
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call):
-                    continue
-                func = node.func
-                callee = (func.id if isinstance(func, ast.Name)
-                          else func.attr if isinstance(func, ast.Attribute)
-                          else None)
-                if callee not in TAG_CALLEES:
-                    continue
-                # the name rides as the positional second argument or as
-                # the name= keyword (raw checkpoint_name accepts both)
-                name = node.args[1] if len(node.args) >= 2 else next(
-                    (kw.value for kw in node.keywords
-                     if kw.arg == "name"), None)
-                if isinstance(name, ast.Constant) and isinstance(
-                        name.value, str):
-                    yield rel, node.lineno, name.value
+REPO = repo_root()
 
 
 def check(repo: str = REPO):
     """Returns (ok, report_lines)."""
-    try:
-        names, save = registry(repo)
-    except (OSError, ValueError) as e:
-        return False, [f"MISSING  registry: {e}"]
-    lines, ok = [], True
-    for extra in [n for n in save if n not in names]:
-        ok = False
-        lines.append(f"ORPHAN   SELECTIVE_SAVE entry {extra!r} is not in "
-                     f"CHECKPOINT_NAMES")
-    for rel, lineno, name in tag_sites(repo):
-        if name in names:
-            lines.append(f"ok       {name} ({rel}:{lineno})")
-        else:
-            ok = False
-            lines.append(f"ORPHAN   {name} ({rel}:{lineno}): tagged but "
-                         f"absent from remat.CHECKPOINT_NAMES — no policy "
-                         f"can save it")
-    return ok, lines
+    return findings_to_ok_lines(*rule_remat_names(repo))
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if "--list" in argv:
-        names, save = registry()
+        names, save = registry(REPO)
         print("CHECKPOINT_NAMES:", ", ".join(names))
         print("SELECTIVE_SAVE:  ", ", ".join(save))
-        for rel, lineno, name in tag_sites():
+        for rel, lineno, name in tag_sites(REPO):
             print(f"{name}\t{rel}:{lineno}")
         return 0
     ok, lines = check()

@@ -1,114 +1,12 @@
-"""Shared jaxpr-inspection helpers for the structural test assertions.
+"""Back-compat shim: the shared jaxpr-inspection helpers were promoted to
+:mod:`apex_tpu.analysis.jaxpr` (PR 11) so the Family-A program lints and
+the structural test suites share one walk. Import from there in new code;
+this module keeps the historical test-local import path resolving."""
 
-Three suites (parallel/DDP, collective matmul, health) pin *program shape*
-— collective counts, zero-cost-off identity — on the traced jaxpr. The
-helpers they had each re-implemented live here once:
-
-- :func:`jaxpr_str` — trace + normalize embedded object addresses, so two
-  closures tracing identical programs compare equal;
-- :func:`count_primitives` — substring census over the jaxpr text (the
-  cheap check: primitive names like ``psum`` / ``ppermute`` appear only as
-  equation heads in jaxpr pretty-printing);
-- :func:`collective_census` — the ring-decomposition census
-  (ppermute / all_gather / reduce_scatter) used by the collective-matmul
-  and ZeRO bucketing assertions;
-- :func:`iter_eqns` / :func:`count_eqns` — structural walk over the jaxpr
-  (recursing into sub-jaxprs) for assertions that need equation *params*
-  (axis names, operand sizes), where text matching would be ambiguous.
-"""
-
-import re
-
-import jax
+from apex_tpu.analysis.jaxpr import (  # noqa: F401
+    collective_census, cone_has_reduction, count_eqns, count_primitives,
+    eqn_axes, eqn_scopes, flat_materializations, iter_eqns,
+    iter_eqns_scoped, jaxpr_of, jaxpr_str, sub_jaxprs, _sub_jaxprs)
 
 __all__ = ["jaxpr_str", "count_primitives", "collective_census",
            "iter_eqns", "count_eqns", "eqn_axes", "flat_materializations"]
-
-
-def eqn_axes(eqn) -> tuple:
-    """The mesh axes a collective equation reduces over, normalized to a
-    tuple of names. reduce_scatter/all_gather carry ``axis_name``; psum
-    (and 0.4.x check_rep's ``psum2`` spelling) carries ``axes``."""
-    ax = eqn.params.get("axis_name") or eqn.params.get("axes")
-    return (ax,) if isinstance(ax, str) else tuple(ax or ())
-
-
-def jaxpr_str(fn, *args) -> str:
-    """Jaxpr text with embedded object addresses normalized: two trainers
-    build distinct model closures, and their reprs (``<function ... at
-    0x...>``) would differ even when the traced programs are identical."""
-    return re.sub(r"0x[0-9a-f]+", "0x", str(jax.make_jaxpr(fn)(*args)))
-
-
-def count_primitives(text: str, *names: str) -> dict:
-    """``{name: substring count}`` over jaxpr text. Order names from most
-    to least specific when one is a prefix of another and subtract at the
-    call site (e.g. ``psum`` also matches ``psum2``-style variants)."""
-    return {name: text.count(name) for name in names}
-
-
-def collective_census(text: str) -> dict:
-    """The collective census shared by the ring-decomposition and
-    DP-bucketing structural tests."""
-    return {"ppermute": text.count("ppermute"),
-            "all_gather": text.count("all_gather"),
-            "reduce_scatter": text.count("reduce_scatter")}
-
-
-def iter_eqns(jaxpr):
-    """Depth-first over every equation, recursing into sub-jaxprs
-    (closed call/scan/shard_map bodies)."""
-    for eqn in jaxpr.eqns:
-        yield eqn
-        for v in eqn.params.values():
-            for sub in _sub_jaxprs(v):
-                yield from iter_eqns(sub)
-
-
-def _sub_jaxprs(value):
-    try:  # the classes moved out of jax.core on the current-jax line
-        from jax.extend.core import ClosedJaxpr, Jaxpr
-    except ImportError:  # pragma: no cover - early 0.4.x
-        from jax.core import ClosedJaxpr, Jaxpr
-    if isinstance(value, ClosedJaxpr):
-        yield value.jaxpr
-    elif isinstance(value, Jaxpr):
-        yield value
-    elif isinstance(value, (list, tuple)):
-        for item in value:
-            yield from _sub_jaxprs(item)
-
-
-def flat_materializations(jaxpr, size, dtype="float32") -> list:
-    """Primitive names of equations that OUTPUT a 1-D ``dtype`` array of
-    exactly ``size`` elements — the structural detector for "the full
-    padded flat gradient materialized" (the barrier the span-local
-    bucketed ravel/unravel removes). Wrapper equations carrying
-    sub-jaxprs (shard_map/pjit/scan/...) are excluded: their outvars are
-    aggregate *views* (e.g. the global aval of a sharded ZeRO master),
-    not buffers the per-device program builds — any real materialization
-    inside them is a leaf equation this walk still visits."""
-    out = []
-    for eqn in iter_eqns(jaxpr):
-        if any(True for v in eqn.params.values() for _ in _sub_jaxprs(v)):
-            continue
-        for v in eqn.outvars:
-            aval = getattr(v, "aval", None)
-            if getattr(aval, "ndim", None) == 1 and aval.size == size \
-                    and str(getattr(aval, "dtype", "")) == dtype:
-                out.append(eqn.primitive.name)
-    return out
-
-
-def count_eqns(fn_or_jaxpr, name, *args, where=None) -> int:
-    """Number of equations whose primitive is ``name``; ``where(eqn)``
-    filters (e.g. on ``eqn.params['axis_name']`` or operand aval sizes).
-    Pass a traceable callable plus its args, or an already-made
-    (Closed)Jaxpr."""
-    if callable(fn_or_jaxpr) and not hasattr(fn_or_jaxpr, "eqns"):
-        jaxpr = jax.make_jaxpr(fn_or_jaxpr)(*args).jaxpr
-    else:
-        jaxpr = getattr(fn_or_jaxpr, "jaxpr", fn_or_jaxpr)
-    return sum(1 for eqn in iter_eqns(jaxpr)
-               if eqn.primitive.name == name
-               and (where is None or where(eqn)))

@@ -595,3 +595,27 @@ def test_bucketing_metrics_surface():
         assert got["ddp/allreduce_bytes"] > 0
     finally:
         parallel_state.destroy_model_parallel()
+
+
+def test_jit_train_step_verify_donation_self_check():
+    """jit_train_step(verify_donation=True): the first dispatch runs the
+    analysis engine's jaxpr-donation rule on the compiled step (every
+    donated leaf aliased, no double-donated buffer) and then dispatches
+    through the verified executable (PR 11)."""
+    from apex_tpu.training import GPTHybridTrainer
+    from apex_tpu.transformer import parallel_state
+
+    cfg = _trainer_cfg(zero=True)
+    tokens, targets = _trainer_data()
+    mesh = cfg.initialize_mesh(devices=jax.devices()[:DP])
+    try:
+        tr = GPTHybridTrainer(cfg, mesh)
+        state = tr.init_state(jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="donate=True"):
+            tr.jit_train_step(donate=False, verify_donation=True)
+        step = tr.jit_train_step(verify_donation=True)
+        loss1, *state = step(*state, tokens, targets)
+        loss2, *_ = step(*state, tokens, targets)  # verified executable
+        assert np.isfinite(float(loss1)) and np.isfinite(float(loss2))
+    finally:
+        parallel_state.destroy_model_parallel()

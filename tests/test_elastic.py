@@ -497,3 +497,40 @@ class TestCrossConfigRestoreGuard:
                 trainer_b.jit_train_step()(*restored, tokens, targets)
         finally:
             parallel_state.destroy_model_parallel()
+
+
+# ---------------------------------------------------------------------------
+# the zero-recompile budget on the production loop (analysis engine, PR 11)
+# ---------------------------------------------------------------------------
+
+class TestFitNoRecompile:
+    def test_steady_loop_passes_the_guard(self, tmp_path):
+        """fit(no_recompile=True): first step + first save are warmup;
+        the steady-state loop must not move the compile-storm counters."""
+        runner = ElasticRunner(ToyTrainer(), _toy_data(), str(tmp_path),
+                               save_interval=2, keep_last=2,
+                               exit_on_preempt=False,
+                               registry=MetricsRegistry())
+        res = runner.fit(6, key=jax.random.PRNGKey(0),
+                         no_recompile=True)
+        assert not res.preempted and res.step == 6
+
+    def test_retracing_step_trips_the_guard(self, tmp_path):
+        """A trainer whose step retraces every call (the storm class the
+        guard exists for) fails fit(no_recompile=True) loudly."""
+        from apex_tpu.analysis import AnalysisError
+
+        class RetracingTrainer(ToyTrainer):
+            def jit_train_step(self):
+                def step(w, opt, rng, x):
+                    # a FRESH jit per dispatch: compiles every step
+                    return jax.jit(ToyTrainer.jit_train_step(self))(
+                        w, opt, rng, x)
+                return step
+
+        runner = ElasticRunner(RetracingTrainer(), _toy_data(),
+                               str(tmp_path), save_interval=2,
+                               exit_on_preempt=False,
+                               registry=MetricsRegistry())
+        with pytest.raises(AnalysisError, match="compile-storm"):
+            runner.fit(6, key=jax.random.PRNGKey(0), no_recompile=True)

@@ -3,7 +3,7 @@
 Covers the roofline cost model (`pyprof/model.py`) — per-primitive FLOP
 pricing against XLA's counting conventions, ring-model collective wire
 bytes, scan/pallas multipliers, `named_scope` region bucketing — the
-trace-join layer (`pyprof/attribute.py`), the `StepReporter.
+trace-join layer (`pyprof/_attribute.py`), the `StepReporter.
 attach_attribution` gauge surface, the bench/script wiring, and the
 acceptance smoke: a real (tiny) GPT train step whose modeled FLOPs must
 match `costs.flops_budget(compiled)` and whose every region is known to
@@ -644,3 +644,29 @@ def test_hybrid_trainer_attribution_report():
     assert "optimizer_step" in names
     known = set(_load_script("check_annotations").ANNOTATIONS)
     assert names <= known | {UNATTRIBUTED}
+
+
+# ---------------------------------------------------------------------------
+# the attribute shadow (PR 6 accepted-wart, fixed in PR 11)
+# ---------------------------------------------------------------------------
+
+def test_attribute_function_not_shadowed_by_submodule():
+    """pyprof.attribute must stay the FUNCTION even after the attribution
+    submodule is imported. The old pyprof/attribute.py made ``import
+    apex_tpu.pyprof.attribute`` rebind the package attribute to the
+    module, clobbering the entry point process-wide; the submodule now
+    lives at pyprof/_attribute.py with its names re-exported."""
+    import importlib
+
+    import apex_tpu.pyprof as pp
+
+    sub = importlib.import_module("apex_tpu.pyprof._attribute")
+    assert callable(pp.attribute)
+    assert pp.attribute is sub.attribute
+    assert pp.AttributionReport is sub.AttributionReport
+    # the shadowing module path is gone for good
+    with pytest.raises(ModuleNotFoundError):
+        importlib.import_module("apex_tpu.pyprof.attribute")
+    # and the from-package import keeps resolving to the function
+    from apex_tpu.pyprof import attribute as fn
+    assert fn is sub.attribute

@@ -589,3 +589,17 @@ class TestSlotScheduler:
         assert {c.request_id for c in sched.completed} == {0, 7}
         assert len(sched.drain_completed()) == 2
         assert sched.completed == []
+
+    def test_run_no_recompile_guard(self):
+        """run(no_recompile=True) wraps the loop in the analysis
+        engine's recompile_guard (PR 11): the steady-state serving loop
+        is live-asserted recompile-free, not just test-asserted."""
+        sched, _ = _sched()
+        reqs = [Request(prompt=[1 + i, 2], max_new_tokens=3)
+                for i in range(4)]
+        out = sched.run(reqs, no_recompile=True)
+        assert sorted(out) == list(range(4))
+        # a second guarded run on the warm engine is also clean
+        out = sched.run([Request(prompt=[9], max_new_tokens=2,
+                                 request_id=9)], no_recompile=True)
+        assert sorted(out) == [9]
