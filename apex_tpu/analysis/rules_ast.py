@@ -44,7 +44,8 @@ __all__ = ["ANNOTATIONS", "ALLOWED_GATHER", "ALLOWED_SCATTER",
            "GRAD_SYNC_PREFIXES", "METRIC_PREFIXES", "EXEMPT_PREFIXES",
            "METRIC_CALLEES", "TAG_CALLEES", "REGISTRY_FILE", "ELASTIC_DIR",
            "CHOKEPOINT_FILE", "CHOKEPOINT_FUNC", "CONFIG_CLASSES",
-           "SECTIONS", "DOC", "rule_annotations", "rule_collectives",
+           "SECTIONS", "SLO_METRICS", "DOC", "rule_annotations",
+           "rule_collectives",
            "rule_metrics_doc", "rule_metric_families", "rule_remat_names",
            "rule_elastic_exits", "rule_bench_configs"]
 
@@ -218,7 +219,7 @@ DOC = os.path.join("docs", "OBSERVABILITY.md")
 # meta-lint requires every slash-prefixed name to belong somewhere.
 METRIC_PREFIXES = ("health/", "tp/", "amp/", "ddp/", "pipeline/",
                    "optim/", "zero/", "mem/", "perf/", "ckpt/", "resume/",
-                   "serve/")
+                   "serve/", "slo/")
 
 # slash-prefixed families that are deliberately OUTSIDE the doc-table
 # contract: jax/* (the compile-storm counters install_compile_listeners
@@ -482,6 +483,12 @@ CONFIG_CLASSES = ("TrainConfig", "ModelConfig", "ParallelConfig",
 SECTIONS = {"model": "ModelConfig", "parallel": "ParallelConfig",
             "batch": "BatchConfig", "optimizer": "OptimizerConfig"}
 
+# the request-latency vocabulary bench.py's stated DECODE_SLO may target
+# (mirrors apex_tpu.observability.slo.LATENCY_METRICS — duplicated here
+# because the AST family must not import the jax-backed package; the
+# mirror is pinned equal in tests/test_analysis.py)
+SLO_METRICS = ("queue_wait_ms", "ttft_ms", "tpot_ms", "e2e_ms")
+
 
 def _dataclass_fields(path: str, class_names) -> dict:
     """``{class_name: {field, ...}}`` from annotated class-body
@@ -547,6 +554,76 @@ def _bench_table(bench_path: str):
     return None
 
 
+def _decode_slo_table(bench_path: str):
+    """The literal ``DECODE_SLO`` tuple from bench.py, or None."""
+    with open(bench_path) as f:
+        tree = ast.parse(f.read(), filename=bench_path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name) \
+                        and target.id == "DECODE_SLO":
+                    return ast.literal_eval(node.value)
+    return None
+
+
+def _check_decode_slo(bench_path: str, findings: list, notes: list):
+    """The stated-SLO contract: ``DECODE_SLO`` entries are
+    ``(metric, quantile, threshold_ms)`` triples over the request-record
+    latency vocabulary — a typo'd metric would score ``goodput`` against
+    a field ``SLOTarget`` rejects only at bench runtime."""
+    try:
+        table = _decode_slo_table(bench_path)
+    except (OSError, SyntaxError, ValueError) as e:
+        findings.append(Finding("ast-bench-configs", "MISSING",
+                                "bench.py DECODE_SLO", str(e)))
+        return
+    if table is None:
+        findings.append(Finding(
+            "ast-bench-configs", "MISSING", "bench.py",
+            "no literal DECODE_SLO table (the gpt_decode_goodput line "
+            "must state its SLO declaratively)"))
+        return
+    if not isinstance(table, (list, tuple)):
+        # a malformed literal must be a FINDING, not a TypeError that
+        # aborts the whole analysis run
+        findings.append(Finding(
+            "ast-bench-configs", "UNKNOWN", "bench.py DECODE_SLO",
+            f"expected a tuple of (metric, quantile, threshold_ms) "
+            f"triples, got {type(table).__name__}"))
+        return
+    ok = True
+    for entry in table:
+        where = f"bench.py DECODE_SLO[{entry!r}]"
+        if not (isinstance(entry, tuple) and len(entry) == 3):
+            ok = False
+            findings.append(Finding(
+                "ast-bench-configs", "UNKNOWN", where,
+                "expected a (metric, quantile, threshold_ms) triple"))
+            continue
+        metric, quantile, threshold = entry
+        if metric not in SLO_METRICS:
+            ok = False
+            findings.append(Finding(
+                "ast-bench-configs", "UNKNOWN", where,
+                f"{metric!r} is not a request-latency metric "
+                f"{SLO_METRICS}"))
+        if not (isinstance(quantile, (int, float))
+                and 0 < quantile < 100):
+            ok = False
+            findings.append(Finding(
+                "ast-bench-configs", "UNKNOWN", where,
+                f"quantile {quantile!r} outside (0, 100)"))
+        if not (isinstance(threshold, (int, float)) and threshold > 0):
+            ok = False
+            findings.append(Finding(
+                "ast-bench-configs", "UNKNOWN", where,
+                f"threshold_ms {threshold!r} must be positive"))
+    if ok:
+        notes.append(f"ok       bench.py DECODE_SLO: {len(table)} "
+                     f"target(s)")
+
+
 def _gpt_step_calls(bench_path: str):
     """``(own_params, [(lineno, kw_names)])`` of every
     ``_gpt_train_step(...)`` call plus the def's own parameters."""
@@ -593,6 +670,8 @@ def rule_bench_configs(repo: str) -> Findings:
                 nkeys = sum(len(v) if isinstance(v, dict) else 1
                             for v in spec.values())
                 notes.append(f"ok       {where}: {nkeys} keys")
+
+    _check_decode_slo(bench_path, findings, notes)
 
     allowed = own_params | tables["GPTConfig"]
     for lineno, kws in calls:

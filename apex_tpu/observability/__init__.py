@@ -6,7 +6,7 @@ The reference exposes runtime behavior only through ad-hoc prints (amp's
 structured replacement: one stream that answers "what did this step spend,
 where, on which rank" without a trace capture.
 
-Six modules, composable and each zero-cost when unused:
+Eight modules, composable and each zero-cost when unused:
 
 - :mod:`~apex_tpu.observability.registry` — host-side counters, gauges and
   fixed-bucket histograms (``Metric.observe()``), grouped in a
@@ -28,7 +28,14 @@ Six modules, composable and each zero-cost when unused:
   hook raises or writes a structured :class:`CrashDump` on a non-finite
   step;
 - :mod:`~apex_tpu.observability.costs` — the peak-flops table and MFU
-  math shared by ``bench.py`` and the reporter's ``perf/mfu`` gauge.
+  math shared by ``bench.py`` and the reporter's ``perf/mfu`` gauge;
+- :mod:`~apex_tpu.observability.reqtrace` /
+  :mod:`~apex_tpu.observability.slo` — the serving-side request
+  lifecycle: per-request span records with TTFT/TPOT/queue-wait/e2e
+  latencies, a bounded flight-recorder ring with a per-slot-swimlane
+  Chrome-trace export, and :class:`SLOTracker` — declarative latency
+  targets, rolling goodput/burn-rate gauges (``slo/*``), and a
+  flight-recorder :class:`CrashDump` on violation.
 
 Hot paths in the library are pre-instrumented (``amp/*``, ``ddp/*``,
 ``pipeline/*``, ``optim/*``, ``health/*`` — see ``docs/OBSERVABILITY.md``);
@@ -37,7 +44,7 @@ no-op that adds nothing to the traced program.
 """
 
 from apex_tpu.observability.registry import (  # noqa: F401
-    Counter, Gauge, Histogram, MetricsRegistry, get_registry)
+    Counter, Gauge, Histogram, MetricsRegistry, get_registry, log_buckets)
 from apex_tpu.observability.ingraph import (  # noqa: F401
     Metrics, aggregate, collecting, reap, record, recording)
 from apex_tpu.observability.trace import (  # noqa: F401
@@ -55,3 +62,7 @@ from apex_tpu.observability.health import (  # noqa: F401
     check_replica_agreement, decode_attribution, tensor_stats)
 from apex_tpu.observability.costs import (  # noqa: F401
     flops_budget, memory_budget, mfu, peak_flops)
+from apex_tpu.observability.reqtrace import (  # noqa: F401
+    LATENCY_BUCKETS_MS, RequestRecord, RequestTrace, chrome_request_trace)
+from apex_tpu.observability.slo import (  # noqa: F401
+    SLOTarget, SLOTracker, SLOViolationError)

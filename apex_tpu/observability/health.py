@@ -502,7 +502,12 @@ class CrashDump:
     needs without re-running the job. ``attribution`` maps each flagged
     tree to the leaf path that went non-finite first
     (:func:`decode_attribution`); ``metrics`` is the full step payload
-    (in-graph + host registry + timers) the reporter had assembled."""
+    (in-graph + host registry + timers) the reporter had assembled;
+    ``requests`` is the serving flight-recorder window — the last-N
+    per-request lifecycle records
+    (:meth:`~apex_tpu.observability.reqtrace.RequestRecord.to_dict`) the
+    :class:`~apex_tpu.observability.slo.SLOTracker` captures on an SLO
+    violation (empty for training-side dumps)."""
 
     step: int
     metrics: Dict[str, float]
@@ -510,23 +515,28 @@ class CrashDump:
     config: Dict[str, Any]
     versions: Dict[str, str]
     wall_time: float
+    requests: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
 
     @classmethod
     def from_payload(cls, step: int, payload: Dict[str, float],
-                     config: Optional[HealthConfig] = None) -> "CrashDump":
+                     config: Optional[HealthConfig] = None,
+                     requests: Sequence[Dict[str, Any]] = ()
+                     ) -> "CrashDump":
         cfg_dict = dataclasses.asdict(config) if config is not None else {}
         cfg_dict = {k: (os.fspath(v) if isinstance(v, os.PathLike) else v)
                     for k, v in cfg_dict.items()}
         return cls(step=int(step), metrics=dict(payload),
                    attribution=decode_attribution(payload),
                    config=cfg_dict, versions=_versions(),
-                   wall_time=time.time())
+                   wall_time=time.time(), requests=list(requests))
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
 
-    def write(self, dump_dir: Union[str, os.PathLike] = ".") -> str:
-        """Write ``health_dump_step<N>.json`` into ``dump_dir`` (created
+    def write(self, dump_dir: Union[str, os.PathLike] = ".",
+              prefix: str = "health_dump") -> str:
+        """Write ``<prefix>_step<N>.json`` into ``dump_dir`` (created
         if missing); returns the path. Non-finite metric values — which
         essentially every real dump carries (``abs_max`` = inf on an
         overflow) — serialize as the STRINGS ``"NaN"``/``"Infinity"``/
@@ -537,8 +547,9 @@ class CrashDump:
         dump_dir = os.fspath(dump_dir)
         os.makedirs(dump_dir, exist_ok=True)
         path = os.path.join(dump_dir,
-                            f"health_dump_step{self.step:08d}.json")
-        doc = dict(self.to_dict(), metrics=json_safe_metrics(self.metrics))
+                            f"{prefix}_step{self.step:08d}.json")
+        doc = dict(self.to_dict(), metrics=json_safe_metrics(self.metrics),
+                   requests=[json_safe_metrics(r) for r in self.requests])
         with open(path, "w") as f:
             json.dump(doc, f, indent=2, sort_keys=True, allow_nan=False)
         return path

@@ -13,15 +13,35 @@ from __future__ import annotations
 
 import bisect
 import math
+import re
 import threading
-from typing import Dict, Iterable, Optional, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "get_registry", "DEFAULT_BUCKETS"]
+           "get_registry", "DEFAULT_BUCKETS", "log_buckets"]
 
 # power-of-4 spread from sub-millisecond to minutes — wide enough for both
 # durations (seconds) and sizes (use explicit buckets for bytes)
 DEFAULT_BUCKETS: Tuple[float, ...] = tuple(4.0 ** e for e in range(-6, 6))
+
+
+def log_buckets(lo: float, hi: float, n: int) -> Tuple[float, ...]:
+    """``n`` log-spaced bucket bounds from ``lo`` to ``hi`` inclusive —
+    the right grid for latency histograms, whose interesting quantiles
+    (p50 vs p99) live decades apart.
+
+    Adjacent bounds keep a constant ratio ``r = (hi/lo)**(1/(n-1))``,
+    which is also the percentile resolution: :meth:`Histogram.percentile`
+    interpolates inside one bucket, so its error is bounded by that
+    bucket's width — relative error at most ``r - 1`` (documented with
+    worked numbers in docs/OBSERVABILITY.md "Serving latency & SLO").
+    """
+    if not (0.0 < lo < hi):
+        raise ValueError(f"need 0 < lo < hi, got lo={lo}, hi={hi}")
+    if n < 2:
+        raise ValueError(f"need at least 2 bounds, got n={n}")
+    ratio = (hi / lo) ** (1.0 / (n - 1))
+    return tuple(lo * ratio ** i for i in range(n))
 
 
 class Metric:
@@ -117,12 +137,26 @@ class Histogram(Metric):
         self._counts = [0] * (len(bounds) + 1)  # +1 = overflow (+inf)
         self._sum = 0.0
         self._count = 0
+        self._min = math.inf
+        self._max = -math.inf
 
     def observe(self, value: float) -> None:
+        # write order matters for lock-free readers: total count (and
+        # sum/extrema) move BEFORE the bucket count, so a concurrent
+        # bucket walk that then reads ``count`` (render_prometheus's
+        # order) always sees count >= running bucket sum — the scraped
+        # histogram stays monotone with le="+Inf" as the ceiling. The
+        # residual tear is benign: percentile() may transiently see a
+        # count one past the bucket sum and falls through to the
+        # observed max.
         value = float(value)
-        self._counts[bisect.bisect_left(self.bounds, value)] += 1
         self._sum += value
         self._count += 1
+        if value < self._min:
+            self._min = value
+        if value > self._max:
+            self._max = value
+        self._counts[bisect.bisect_left(self.bounds, value)] += 1
 
     @property
     def count(self) -> int:
@@ -131,6 +165,62 @@ class Histogram(Metric):
     @property
     def sum(self) -> float:
         return self._sum
+
+    def _order_stat(self, k: int) -> float:
+        """Bucket estimate of the k-th order statistic (1-indexed).
+        Exact at the ends (the observed min/max are tracked); interior
+        ranks spread uniformly inside their bucket, clamped to the
+        observed range — so the estimate never leaves the true value's
+        bucket."""
+        if k <= 1:
+            return self._min
+        if k >= self._count:
+            return self._max
+        running = 0
+        lo = -math.inf
+        bounds = (*self.bounds, math.inf)  # +inf = the overflow bucket
+        for bound, c in zip(bounds, self._counts):
+            if c and running + c >= k:
+                b_lo = max(lo, self._min)
+                b_hi = min(bound, self._max)
+                est = b_lo + (b_hi - b_lo) * ((k - running) / c)
+                return min(max(est, self._min), self._max)
+            running += c
+            lo = bound
+        # reachable only on a torn lock-free read (count incremented
+        # before its bucket): the observed max is the honest answer
+        return self._max
+
+    def percentile(self, q: float) -> float:
+        """The ``q``-th percentile (``q`` in [0, 100]) in numpy's
+        linear-interpolation convention — the fractional rank
+        ``1 + q/100·(count−1)`` interpolated between the two adjacent
+        order statistics, each estimated from its bucket
+        (:meth:`_order_stat`) — so small windows (a handful of requests)
+        agree with ``np.percentile`` up to bucket resolution instead of
+        drifting to a different rank convention.
+
+        Each order-statistic estimate stays inside the true value's
+        bucket and is clamped to the tracked observed ``[min, max]``
+        (q=0/q=100 are exact; the overflow bucket reads the observed
+        maximum instead of fabricating +inf), so the error is bounded by
+        one bucket's width at each endpoint: with :func:`log_buckets`'
+        constant-ratio grid the *relative* error vs ``np.percentile`` is
+        at most ``r - 1`` where ``r = (hi/lo)**(1/(n-1))`` for in-grid
+        samples — the resolution/emission-size trade-off, documented in
+        docs/OBSERVABILITY.md. Returns NaN on an empty histogram.
+        """
+        if not 0.0 <= q <= 100.0:
+            raise ValueError(f"q must be in [0, 100], got {q}")
+        if self._count == 0:
+            return math.nan
+        pos = 1.0 + (q / 100.0) * (self._count - 1)
+        k = int(math.floor(pos))
+        frac = pos - k
+        x_k = self._order_stat(k)
+        if frac <= 0.0 or k >= self._count:
+            return x_k
+        return x_k + frac * (self._order_stat(k + 1) - x_k)
 
     def bucket_counts(self) -> Dict[str, int]:
         """Cumulative counts, honoring the Prometheus ``le`` contract:
@@ -154,6 +244,8 @@ class Histogram(Metric):
         self._counts = [0] * (len(self.bounds) + 1)
         self._sum = 0.0
         self._count = 0
+        self._min = math.inf
+        self._max = -math.inf
 
 
 class MetricsRegistry:
@@ -206,6 +298,59 @@ class MetricsRegistry:
     def reset(self) -> None:
         for m in self._metrics.values():
             m.reset()
+
+    def render_prometheus(self) -> str:
+        """The registry in Prometheus text exposition format, so a host
+        process can serve the snapshot on a ``/metrics`` endpoint and be
+        scraped without any new dependency.
+
+        Slashes (and anything else outside ``[a-zA-Z0-9_:]``) in metric
+        names become underscores (``serve/ttft_ms`` →
+        ``serve_ttft_ms``); histograms emit the standard cumulative
+        ``_bucket{le="..."}`` series ending in ``le="+Inf"`` plus
+        ``_sum``/``_count``; never-set gauges are skipped (same contract
+        as :meth:`snapshot`), and non-finite values use the spellings
+        Prometheus' parser accepts (``NaN``/``+Inf``/``-Inf``).
+        """
+        with self._lock:
+            metrics = list(self._metrics.items())
+        lines: List[str] = []
+        for name, m in metrics:
+            pn = _prometheus_name(name)
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {pn} counter")
+                lines.append(f"{pn} {_prometheus_value(m.value)}")
+            elif isinstance(m, Gauge):
+                if not m.is_set:
+                    continue
+                lines.append(f"# TYPE {pn} gauge")
+                lines.append(f"{pn} {_prometheus_value(m.value)}")
+            elif isinstance(m, Histogram):
+                lines.append(f"# TYPE {pn} histogram")
+                running = 0
+                for bound, c in zip(m.bounds, m._counts):
+                    running += c
+                    lines.append(f'{pn}_bucket{{le="{bound:g}"}} {running}')
+                lines.append(f'{pn}_bucket{{le="+Inf"}} {m.count}')
+                lines.append(f"{pn}_sum {_prometheus_value(m.sum)}")
+                lines.append(f"{pn}_count {m.count}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+_PROM_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prometheus_name(name: str) -> str:
+    pn = _PROM_BAD_CHARS.sub("_", name)
+    return "_" + pn if pn[:1].isdigit() else pn
+
+
+def _prometheus_value(value: float) -> str:
+    if math.isnan(value):
+        return "NaN"
+    if math.isinf(value):
+        return "+Inf" if value > 0 else "-Inf"
+    return f"{value:g}"
 
 
 _DEFAULT: Optional[MetricsRegistry] = None

@@ -1,0 +1,284 @@
+"""Serving SLO tracking: declarative latency targets, rolling goodput,
+burn rate, and a flight-recorder dump on violation.
+
+An aggregate throughput number cannot answer the production question
+"what fraction of traffic met its latency target this window"; goodput
+can, and it is the quantity the ROADMAP's serving items are actually
+optimizing. Three pieces, all riding the existing telemetry spine:
+
+- :class:`SLOTarget` — one declarative target, e.g. *TTFT p95 <= 200 ms*
+  (``metric`` is one of the request-record latency fields, ``quantile``
+  defines both the percentile readout to police and the implied error
+  budget ``1 - q/100``);
+- :class:`SLOTracker` — the rolling evaluator:
+  :meth:`~SLOTracker.observe` ingests each retired
+  :class:`~apex_tpu.observability.reqtrace.RequestRecord` (the
+  :class:`~apex_tpu.serving.scheduler.SlotScheduler` calls it when wired
+  via ``slo=``), keeps per-target value windows, and maintains the
+  ``slo/*`` host-registry gauges — goodput (fraction of windowed
+  requests meeting ALL targets), burn rate (violation fraction over the
+  error budget: 1.0 = burning exactly the budget, >1 = on track to miss
+  the SLO), and a 0/1 ``violating`` flag (any target's window percentile
+  over its threshold);
+- the **reporter hook** — the tracker is itself a
+  ``StepReporter(hooks=[...])`` callable, the same attachment point as
+  PR 3's :class:`~apex_tpu.observability.health.HealthMonitor`: on a
+  violating report (after ``consecutive`` violating reports in a row) it
+  writes a flight-recorder
+  :class:`~apex_tpu.observability.health.CrashDump` whose ``requests``
+  field carries the last-N request records from the attached
+  :class:`~apex_tpu.observability.reqtrace.RequestTrace` — the
+  post-mortem shows WHICH requests blew the target and where their time
+  went, not just that a percentile moved. ``on_violation="raise"``
+  additionally raises :class:`SLOViolationError`.
+
+Everything here is host-side arithmetic over already-collected
+timestamps: attaching a tracker adds zero device work to the serving
+loop (the zero-cost contract ``tests/test_reqtrace.py`` asserts).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from apex_tpu.observability.health import CrashDump
+from apex_tpu.observability.registry import get_registry
+from apex_tpu.observability.reqtrace import RequestRecord, RequestTrace
+
+__all__ = ["SLOTarget", "SLOTracker", "SLOViolationError",
+           "LATENCY_METRICS", "ON_VIOLATION"]
+
+LATENCY_METRICS = ("queue_wait_ms", "ttft_ms", "tpot_ms", "e2e_ms")
+ON_VIOLATION = ("skip", "dump", "raise")
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOTarget:
+    """One latency objective: ``metric``'s p-``quantile`` must stay at or
+    under ``threshold_ms``. The quantile also defines the error budget —
+    *p95 <= X* tolerates 5% of requests over X; the per-target burn rate
+    is the observed over-threshold fraction divided by that budget."""
+
+    metric: str
+    quantile: float
+    threshold_ms: float
+
+    def __post_init__(self):
+        if self.metric not in LATENCY_METRICS:
+            raise ValueError(f"metric must be one of {LATENCY_METRICS}, "
+                             f"got {self.metric!r}")
+        if not 0.0 < self.quantile < 100.0:
+            raise ValueError("quantile must be in (0, 100), "
+                             f"got {self.quantile!r}")
+        if self.threshold_ms <= 0.0:
+            raise ValueError("threshold_ms must be positive, "
+                             f"got {self.threshold_ms!r}")
+
+    @property
+    def error_budget(self) -> float:
+        return 1.0 - self.quantile / 100.0
+
+    def describe(self) -> str:
+        return f"{self.metric} p{self.quantile:g} <= {self.threshold_ms:g}ms"
+
+
+class SLOViolationError(RuntimeError):
+    """An SLO target's window percentile exceeded its threshold and the
+    tracker's policy said ``on_violation="raise"``. Carries the
+    flight-recorder :class:`CrashDump` and the path it was written to."""
+
+    def __init__(self, message: str, dump: CrashDump,
+                 dump_path: Optional[str] = None):
+        super().__init__(message)
+        self.dump = dump
+        self.dump_path = dump_path
+
+
+class SLOTracker:
+    """See module docstring.
+
+    Args:
+      targets: the declarative :class:`SLOTarget` list (at least one).
+      window: rolling window size in *requests* — goodput, burn rate and
+        the percentile checks all read the last ``window`` retirements.
+      registry: host :class:`MetricsRegistry` for the ``slo/*`` family
+        (the process default when None).
+      trace: the :class:`RequestTrace` flight-recorder source; when
+        attached, violation dumps carry its last ``flight_n`` records.
+      on_violation: the reporter-hook reaction — ``"skip"`` keeps the
+        gauges only, ``"dump"`` writes the flight-recorder dump,
+        ``"raise"`` dumps then raises :class:`SLOViolationError`.
+      dump_dir: where ``slo_dump_step<N>.json`` files land.
+      flight_n: how many trailing request records a dump carries.
+      consecutive: violating *reports* in a row before the hook fires
+        (a clean report resets the streak) — one hot request in a small
+        window should not page anyone; same knob as the health monitor.
+    """
+
+    def __init__(self, targets: Sequence[SLOTarget], *, window: int = 512,
+                 registry=None, trace: Optional[RequestTrace] = None,
+                 on_violation: str = "dump", dump_dir: str = ".",
+                 flight_n: int = 64, consecutive: int = 1):
+        targets = tuple(targets)
+        if not targets:
+            raise ValueError("need at least one SLOTarget")
+        if on_violation not in ON_VIOLATION:
+            raise ValueError(f"on_violation must be one of {ON_VIOLATION}, "
+                             f"got {on_violation!r}")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if consecutive < 1:
+            raise ValueError(f"consecutive must be >= 1, got {consecutive}")
+        self.targets = targets
+        self.window = int(window)
+        self.trace = trace
+        self.on_violation = on_violation
+        self.dump_dir = dump_dir
+        self.flight_n = int(flight_n)
+        self.consecutive = int(consecutive)
+        self._reg = registry if registry is not None else get_registry()
+        # rolling windows with INCREMENTAL counters: observe() sits on
+        # the scheduler's retirement path, so every readout it refreshes
+        # must be O(targets), not an O(window) rescan (eviction is
+        # handled explicitly — a maxlen deque would drop samples without
+        # letting the counters follow)
+        self._vals = [collections.deque() for _ in targets]
+        self._over = [0 for _ in targets]
+        self._good: collections.deque = collections.deque()
+        self._good_count = 0
+        self.dumps: List[str] = []
+        self.streak = 0
+        self._last_dump: Optional[CrashDump] = None
+
+    # -- ingestion ----------------------------------------------------------
+
+    def observe(self, record: RequestRecord) -> None:
+        """Ingest one retired request: window updates + ``slo/*`` gauges,
+        O(targets) per call (counters maintained incrementally). A
+        latency a request does not define (``tpot_ms`` on a one-token
+        request) neither counts for nor against its targets."""
+        good = True
+        for i, target in enumerate(self.targets):
+            v = getattr(record, target.metric)
+            if v is None:
+                continue
+            vals = self._vals[i]
+            if len(vals) >= self.window:
+                if vals.popleft() > target.threshold_ms:
+                    self._over[i] -= 1
+            vals.append(float(v))
+            if v > target.threshold_ms:
+                self._over[i] += 1
+                good = False
+        if len(self._good) >= self.window:
+            self._good_count -= self._good.popleft()
+        self._good.append(good)
+        self._good_count += good
+        self._update_gauges()
+
+    # -- rolling readouts ---------------------------------------------------
+
+    def goodput(self) -> float:
+        """Fraction of windowed requests that met EVERY target's
+        threshold (NaN before the first retirement)."""
+        if not self._good:
+            return float("nan")
+        return self._good_count / len(self._good)
+
+    def burn_rate(self, target: SLOTarget) -> float:
+        """Observed over-threshold fraction over the target's error
+        budget: 1.0 burns exactly the budget the quantile allows, >1 is
+        on track to violate (the SRE burn-rate convention). NaN with no
+        samples."""
+        i = self.targets.index(target)
+        if not self._vals[i]:
+            return float("nan")
+        return (self._over[i] / len(self._vals[i])) / target.error_budget
+
+    def window_percentile(self, target: SLOTarget) -> float:
+        """The target metric's p-``quantile`` over the rolling window —
+        exact ``np.percentile`` over the retained samples, computed on
+        demand (violation messages, debugging), NOT on the per-
+        retirement path."""
+        i = self.targets.index(target)
+        vals = self._vals[i]
+        if not vals:
+            return float("nan")
+        return float(np.percentile(np.asarray(vals), target.quantile))
+
+    def violating_targets(self) -> List[SLOTarget]:
+        """Targets currently violating: the windowed over-threshold
+        fraction exceeds the error budget — the exceedance-rate
+        statement of "the window's p-quantile sits above the threshold"
+        (identical up to interpolation convention), evaluated from the
+        incremental counters in O(targets)."""
+        return [t for t in self.targets
+                if self.burn_rate(t) > 1.0]  # NaN-safe: NaN > 1 is False
+
+    def _update_gauges(self) -> None:
+        reg = self._reg
+        reg.gauge("slo/goodput").set(self.goodput())
+        burns = [self.burn_rate(t) for t in self.targets]
+        burns = [b for b in burns if b == b]
+        if burns:
+            reg.gauge("slo/burn_rate").set(max(burns))
+        reg.gauge("slo/violating").set(
+            1.0 if self.violating_targets() else 0.0)
+        reg.gauge("slo/window_requests").set(float(len(self._good)))
+
+    # -- the flight recorder ------------------------------------------------
+
+    def flight_dump(self, step: int = 0,
+                    payload: Optional[Dict[str, float]] = None) -> str:
+        """Write the flight-recorder dump NOW (also callable from an
+        except block around the serving loop — the "or crash" half of the
+        contract): a strict-JSON :class:`CrashDump` whose ``requests``
+        field holds the last ``flight_n`` request records. Returns the
+        written path."""
+        records = self.trace.last(self.flight_n) if self.trace else []
+        dump = CrashDump.from_payload(
+            step, payload if payload is not None else {},
+            requests=[r.to_dict() for r in records])
+        dump.config = {
+            "targets": [t.describe() for t in self.targets],
+            "window": self.window, "on_violation": self.on_violation,
+            "flight_n": self.flight_n, "consecutive": self.consecutive,
+        }
+        path = dump.write(self.dump_dir, prefix="slo_dump")
+        self.dumps.append(path)
+        self._last_dump = dump
+        return path
+
+    # -- the StepReporter hook ----------------------------------------------
+
+    def __call__(self, step: int, payload: Dict[str, float]) -> None:
+        """``StepReporter(hooks=[tracker])`` — evaluated once per
+        reported payload, after the sinks emitted (the stream always
+        carries the violating window's gauges)."""
+        if self.on_violation == "skip":
+            return
+        violating = self.violating_targets()
+        if not violating:
+            self.streak = 0
+            return
+        self.streak += 1
+        if self.streak < self.consecutive:
+            return
+        self._reg.counter("slo/violations").inc()
+        path = self.flight_dump(step, payload)
+        if self.on_violation == "raise":
+            desc = "; ".join(
+                f"{t.describe()} (p{t.quantile:g}="
+                f"{self.window_percentile(t):.1f}ms)" for t in violating)
+            raise SLOViolationError(
+                f"SLO violated at step {step}: {desc}; flight recorder: "
+                f"{path}", self._last_dump, dump_path=path)
+
+    def reporter_hook(self) -> "SLOTracker":
+        """Symmetry with ``HealthConfig.reporter_hook()`` — the tracker
+        IS the hook."""
+        return self

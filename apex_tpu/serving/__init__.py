@@ -6,13 +6,24 @@ steps with donated cache buffers
 (:class:`~apex_tpu.serving.engine.ServingEngine`), fixed-shape sampling
 (:mod:`~apex_tpu.serving.sampling`), and a continuous slot batcher
 (:class:`~apex_tpu.serving.scheduler.SlotScheduler`) emitting the
-``serve/*`` metric family.
+``serve/*`` metric family. The request-lifecycle observability layer
+(per-request TTFT/TPOT/queue-wait tracing, the Chrome swimlane export,
+and SLO goodput tracking) lives in
+:mod:`apex_tpu.observability.reqtrace` /
+:mod:`~apex_tpu.observability.slo` and is re-exported here for
+wiring convenience (``SlotScheduler(engine, trace=..., slo=...)``).
 """
 
+from apex_tpu.observability.reqtrace import (RequestRecord, RequestTrace,
+                                             chrome_request_trace)
+from apex_tpu.observability.slo import (SLOTarget, SLOTracker,
+                                        SLOViolationError)
 from apex_tpu.serving.cache import KVCache, cache_bytes_per_slot
 from apex_tpu.serving.engine import ServingEngine
 from apex_tpu.serving.sampling import sample_tokens
 from apex_tpu.serving.scheduler import Completion, Request, SlotScheduler
 
 __all__ = ["KVCache", "cache_bytes_per_slot", "ServingEngine",
-           "sample_tokens", "Completion", "Request", "SlotScheduler"]
+           "sample_tokens", "Completion", "Request", "SlotScheduler",
+           "RequestRecord", "RequestTrace", "chrome_request_trace",
+           "SLOTarget", "SLOTracker", "SLOViolationError"]

@@ -14,6 +14,20 @@ Host-side state is deliberately tiny (per-slot last token, temperature,
 budget counters); everything sequence-shaped lives in the device cache
 behind its write cursor. The loop emits the ``serve/*`` host-registry
 metric family (docs/OBSERVABILITY.md) each step.
+
+**Request lifecycle.** Every request carries a
+:class:`~apex_tpu.observability.reqtrace.RequestRecord`: ``submit``
+stamps the enqueue time, admission/prefill/decode/retire each stamp one
+``time.perf_counter()`` per transition (the WHOLE hot-loop tracing
+overhead — the device programs are untouched), so completions report
+measured ``queue_wait_ms``/``ttft_ms``/``tpot_ms``/``e2e_ms`` and the
+registry grows the matching ``serve/*`` latency histograms. Attaching a
+:class:`~apex_tpu.observability.reqtrace.RequestTrace` (``trace=``)
+additionally keeps retired records in its ring buffer (with per-tick
+timestamps) for the Chrome-trace export; an
+:class:`~apex_tpu.observability.slo.SLOTracker` (``slo=``) ingests each
+retirement for goodput/burn-rate. Both default off and neither adds
+device work (asserted in ``tests/test_reqtrace.py``).
 """
 
 from __future__ import annotations
@@ -26,6 +40,8 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from apex_tpu.observability import get_registry
+from apex_tpu.observability.reqtrace import (LATENCY_BUCKETS_MS,
+                                             RequestRecord)
 
 __all__ = ["Request", "Completion", "SlotScheduler"]
 
@@ -44,11 +60,19 @@ class Request:
 
 @dataclasses.dataclass
 class Completion:
-    """A finished request: the generated tokens (prompt excluded) and
-    why generation stopped (``"eos"`` | ``"length"`` | ``"capacity"``)."""
+    """A finished request: the generated tokens (prompt excluded), why
+    generation stopped (``"eos"`` | ``"length"`` | ``"capacity"``), and
+    the measured per-request latencies — ``queue_wait_ms`` (submit →
+    slot), ``ttft_ms`` (submit → first token, queue wait included),
+    ``tpot_ms`` (mean per-token after the first; None for single-token
+    requests), ``e2e_ms`` (submit → retire)."""
     request_id: int
     tokens: List[int]
     finish_reason: str
+    queue_wait_ms: Optional[float] = None
+    ttft_ms: Optional[float] = None
+    tpot_ms: Optional[float] = None
+    e2e_ms: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -56,15 +80,25 @@ class _Active:
     request: Request
     generated: List[int]
     position: int            # prompt_len + len(generated), vs cache capacity
+    record: RequestRecord
 
 
 class SlotScheduler:
     """See module docstring. Drive it with :meth:`submit` + :meth:`step`
-    (one decode step per call), or :meth:`run` for a closed batch."""
+    (one decode step per call), or :meth:`run` for a closed batch.
 
-    def __init__(self, engine, registry=None):
+    ``trace`` (optional :class:`RequestTrace`) keeps retired request
+    records in a bounded ring for Chrome-trace export / flight-recorder
+    dumps; ``slo`` (optional :class:`SLOTracker`) ingests each
+    retirement. With both None the only lifecycle cost left is one
+    timestamp per transition — the latency fields on completions and the
+    ``serve/*_ms`` histograms are always real measurements."""
+
+    def __init__(self, engine, registry=None, trace=None, slo=None):
         self.engine = engine
         self._reg = registry if registry is not None else get_registry()
+        self.trace = trace
+        self.slo = slo
         self.queue: collections.deque = collections.deque()
         self.free: List[int] = list(range(engine.max_seqs))[::-1]
         self.active: Dict[int, _Active] = {}
@@ -96,7 +130,12 @@ class SlotScheduler:
         if request.request_id is None:
             request.request_id = self._next_id
         self._next_id = max(self._next_id, request.request_id) + 1
-        self.queue.append(request)
+        # the enqueue stamp: queue wait is measured from here, not
+        # inferred from admission order
+        record = RequestRecord(request_id=request.request_id,
+                               prompt_len=len(request.prompt),
+                               submit_t=time.perf_counter())
+        self.queue.append((request, record))
         return request.request_id
 
     @property
@@ -105,15 +144,38 @@ class SlotScheduler:
 
     # -- the loop -----------------------------------------------------------
 
-    def _retire(self, slot: int, reason: str) -> None:
+    def _retire(self, slot: int, reason: str, now: float) -> None:
         st = self.active.pop(slot)
         # zero the cursor: an idle slot left deep in the cache would keep
         # paying full-prefix attention on every later decode step
         self.engine.release_slot(slot)
         self.free.append(slot)
-        self.completed.append(Completion(st.request.request_id,
-                                         st.generated, reason))
+        rec = st.record
+        rec.retire_t = now
+        rec.finish_reason = reason
+        rec.generated = len(st.generated)
+        self.completed.append(Completion(
+            st.request.request_id, st.generated, reason,
+            queue_wait_ms=rec.queue_wait_ms, ttft_ms=rec.ttft_ms,
+            tpot_ms=rec.tpot_ms, e2e_ms=rec.e2e_ms))
         self._reg.counter("serve/retired").inc()
+        if rec.queue_wait_ms is not None:
+            self._reg.histogram("serve/queue_wait_ms",
+                                LATENCY_BUCKETS_MS).observe(
+                                    rec.queue_wait_ms)
+        if rec.ttft_ms is not None:
+            self._reg.histogram("serve/ttft_ms",
+                                LATENCY_BUCKETS_MS).observe(rec.ttft_ms)
+        if rec.tpot_ms is not None:
+            self._reg.histogram("serve/tpot_ms",
+                                LATENCY_BUCKETS_MS).observe(rec.tpot_ms)
+        if rec.e2e_ms is not None:
+            self._reg.histogram("serve/e2e_ms",
+                                LATENCY_BUCKETS_MS).observe(rec.e2e_ms)
+        if self.trace is not None:
+            self.trace.append(rec)
+        if self.slo is not None:
+            self.slo.observe(rec)
 
     def _finish_reason(self, st: _Active, tok: int) -> Optional[str]:
         req = st.request
@@ -125,22 +187,32 @@ class SlotScheduler:
             return "capacity"
         return None
 
-    def _record(self, tok: int, st: _Active, slot: int) -> None:
+    def _record(self, tok: int, st: _Active, slot: int, now: float,
+                is_tick: bool) -> None:
         st.generated.append(tok)
         st.position += 1
         self._tokens[slot] = tok
         self._tok_count += 1
+        st.record.last_token_t = now
+        if is_tick and self.trace is not None:
+            st.record.decode_ts.append(now)
         reason = self._finish_reason(st, tok)
         if reason is not None:
-            self._retire(slot, reason)
+            self._retire(slot, reason, now)
 
     def _admit(self) -> int:
         admitted = 0
         while self.queue and self.free:
-            req = self.queue.popleft()
+            req, rec = self.queue.popleft()
             slot = self.free.pop()
+            rec.admit_t = time.perf_counter()
+            rec.slot = slot
             first = self.engine.prefill(req.prompt, slot, req.temperature)
-            st = _Active(req, [], len(req.prompt))
+            # prefill() syncs on the sampled token, so this stamp is the
+            # honest first-token time (prefill-done == first-token: the
+            # admission program samples it)
+            rec.prefill_done_t = rec.first_token_t = time.perf_counter()
+            st = _Active(req, [], len(req.prompt), rec)
             self.active[slot] = st
             self._temps[slot] = req.temperature
             self._reg.counter("serve/admitted").inc()
@@ -148,7 +220,8 @@ class SlotScheduler:
             admitted += 1
             # the prefill already sampled this request's first token —
             # it may even complete here (max_new_tokens == 1)
-            self._record(first, st, slot)
+            self._record(first, st, slot, rec.first_token_t,
+                         is_tick=False)
         return admitted
 
     def step(self) -> int:
@@ -164,9 +237,13 @@ class SlotScheduler:
             mask[list(self.active)] = True
             nxt = self.engine.decode(self._tokens, self._temps, mask)
             self._reg.counter("serve/decode_steps").inc()
+            # ONE stamp for the whole grid's tick (decode() synced on
+            # the fetched tokens) — the per-transition overhead contract
+            now = time.perf_counter()
             # snapshot: _record may retire and free slots mid-harvest
             for slot in list(self.active):
-                self._record(int(nxt[slot]), self.active[slot], slot)
+                self._record(int(nxt[slot]), self.active[slot], slot, now,
+                             is_tick=True)
         generated = self._tok_count - before
         self._reg.counter("serve/generated_tokens").inc(generated)
         self._reg.gauge("serve/queue_depth").set(len(self.queue))

@@ -4,7 +4,10 @@
 Builds a small randomly-initialized GPT, compiles the AOT prefill/decode
 steps once (donated KV cache), enqueues a mixed bag of requests (greedy
 and sampled, different lengths), streams tokens as slots produce them,
-and prints the ``serve/*`` metric summary. On 2 slots and 6 requests the
+and prints the ``serve/*`` metric summary — including the per-request
+latency percentiles (TTFT/TPOT p50/p95/p99 off the ``serve/*_ms``
+histograms) and the rolling goodput under a demo SLO, plus a per-slot
+Chrome swimlane trace (``--trace-out``). On 2 slots and 6 requests the
 log shows the continuous-batching shape: short requests retire and their
 slots re-admit from the queue while long ones keep decoding.
 
@@ -18,7 +21,8 @@ import numpy as np
 
 from apex_tpu.models import GPTConfig, GPTModel
 from apex_tpu.observability.registry import MetricsRegistry
-from apex_tpu.serving import Request, ServingEngine, SlotScheduler
+from apex_tpu.serving import (Request, RequestTrace, ServingEngine,
+                              SLOTarget, SLOTracker, SlotScheduler)
 
 
 def main(argv=None):
@@ -36,6 +40,13 @@ def main(argv=None):
     ap.add_argument("--int8-cache", action="store_true",
                     help="quantized KV cache (per-(position,head) "
                          "scales); halves cache HBM per slot")
+    ap.add_argument("--trace-out", default=None,
+                    help="write the per-request Chrome trace (one "
+                         "swimlane per slot) to this path")
+    ap.add_argument("--ttft-slo-ms", type=float, default=5000.0,
+                    help="demo SLO: TTFT p95 threshold")
+    ap.add_argument("--tpot-slo-ms", type=float, default=1000.0,
+                    help="demo SLO: TPOT p99 threshold")
     args = ap.parse_args(argv)
 
     cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
@@ -54,7 +65,12 @@ def main(argv=None):
           f"would hold ~{engine.suggest_max_seqs(16 << 30)} slots")
 
     reg = MetricsRegistry()
-    sched = SlotScheduler(engine, registry=reg)
+    targets = (SLOTarget("ttft_ms", 95, args.ttft_slo_ms),
+               SLOTarget("tpot_ms", 99, args.tpot_slo_ms))
+    trace = RequestTrace(capacity=256)
+    slo = SLOTracker(targets, registry=reg, trace=trace,
+                     on_violation="skip")
+    sched = SlotScheduler(engine, registry=reg, trace=trace, slo=slo)
     rng = np.random.RandomState(0)
     for i in range(args.requests):
         prompt = rng.randint(1, args.vocab,
@@ -90,11 +106,40 @@ def main(argv=None):
     for rid in sorted(results):
         c = results[rid]
         print(f"req {rid}: {len(c.tokens)} tokens, "
-              f"finished by {c.finish_reason}")
+              f"finished by {c.finish_reason} "
+              f"(wait {c.queue_wait_ms:.1f}ms, ttft {c.ttft_ms:.1f}ms, "
+              f"e2e {c.e2e_ms:.1f}ms)")
     snap = {k: v for k, v in reg.snapshot().items()
-            if k.startswith("serve/")}
+            if k.startswith("serve/") and "_bucket_le_" not in k
+            and not k.endswith(("_count", "_sum"))}
     print("serve/* summary:", {k: round(v, 1) for k, v in snap.items()})
-    return {"completions": results, "metrics": snap}
+
+    # the latency/SLO summary: percentiles off the serve/*_ms histograms
+    # (the same readout bench_gpt_decode ships), goodput off the tracker.
+    # LATENCY_BUCKETS_MS matters on the get-or-create: a histogram the
+    # scheduler never touched (tpot with --max-new-tokens 1) must still
+    # land on the documented latency grid, not DEFAULT_BUCKETS
+    from apex_tpu.observability import LATENCY_BUCKETS_MS
+    latency = {}
+    for short, name in (("ttft", "serve/ttft_ms"),
+                        ("tpot", "serve/tpot_ms"),
+                        ("queue_wait", "serve/queue_wait_ms"),
+                        ("e2e", "serve/e2e_ms")):
+        hist = reg.histogram(name, LATENCY_BUCKETS_MS)
+        latency.update({f"{short}_p{q}_ms": round(hist.percentile(q), 2)
+                        for q in (50, 95, 99)})
+    goodput = slo.goodput()
+    print("latency percentiles (ms):",
+          {k: v for k, v in latency.items()
+           if k.startswith(("ttft", "tpot"))})
+    print(f"goodput {goodput:.3f} under SLO "
+          f"[{'; '.join(t.describe() for t in targets)}]")
+    if args.trace_out:
+        trace.write_chrome_trace(args.trace_out)
+        print(f"chrome request trace ({len(trace)} records, one lane "
+              f"per slot) -> {args.trace_out}")
+    return {"completions": results, "metrics": snap, "latency": latency,
+            "goodput": goodput, "slo": [t.describe() for t in targets]}
 
 
 if __name__ == "__main__":

@@ -163,21 +163,26 @@ def _expect_grad_psum(findings):
 def _plant_metrics_doc(tmp_path):
     _write(tmp_path, "apex_tpu/m.py",
            "from apex_tpu.observability import ingraph\n"
-           "def f(x, name, registry, reg):\n"
+           "def f(x, name, registry, reg, buckets):\n"
            "    ingraph.record('health/rogue_metric', x)\n"
            "    ingraph.record(f'health/{name}/rogue_family', x)\n"
            "    registry.gauge('perf/rogue_attribution').set(x)\n"
            "    reg.counter('ckpt/rogue_bytes').inc(x)\n"
-           "    reg.histogram('serve/rogue_ms').observe(x)\n")
+           "    reg.histogram('serve/rogue_ms').observe(x)\n"
+           # the PR 12 call shapes: a bucketed latency histogram and an
+           # slo/ gauge — the doc contract must see through both
+           "    reg.histogram('serve/rogue_wait_ms', buckets).observe(x)\n"
+           "    reg.gauge('slo/rogue_goodput').set(x)\n")
     _write(tmp_path, "docs/OBSERVABILITY.md", "| nothing documented |\n")
 
 
 def _expect_metrics_doc(findings):
     undoc = [f for f in findings if f.kind == "UNDOC"]
-    assert len(undoc) == 5  # record x2 + gauge + counter + histogram
+    assert len(undoc) == 7  # record x2 + gauge x2 + counter + hist x2
     for name in ("health/rogue_metric", "health/<>/rogue_family",
                  "perf/rogue_attribution", "ckpt/rogue_bytes",
-                 "serve/rogue_ms"):
+                 "serve/rogue_ms", "serve/rogue_wait_ms",
+                 "slo/rogue_goodput"):
         assert any(name in f.message for f in undoc), name
 
 
@@ -188,6 +193,7 @@ def _plant_metric_family(tmp_path):
            "    reg.counter('jax/compiles').inc()\n"          # exempt
            "    reg.gauge(f'memory/peak/device{i}').set(x)\n"  # exempt
            "    reg.gauge('serve/queue_depth').set(x)\n"       # known
+           "    reg.gauge('slo/goodput').set(x)\n"             # known (PR 12)
            "    reg.gauge('no_slash_name').set(x)\n")          # unprefixed
     # even a documented row does not excuse an unregistered FAMILY
     _write(tmp_path, "docs/OBSERVABILITY.md", "| `newfam/widgets` |\n")
@@ -274,6 +280,12 @@ def _plant_bench(tmp_path):
         "          'bucket_bytes': 4096,\n"
         "          'optimizer': {'zero': 1}},\n"
         "}\n"
+        # stated-SLO contract: one bad metric name, one bad quantile,
+        # one bad threshold, one fully valid triple
+        "DECODE_SLO = (('latency_ms', 95.0, 2000.0),\n"
+        "              ('ttft_ms', 101.0, 500.0),\n"
+        "              ('tpot_ms', 99.0, 0.0),\n"
+        "              ('e2e_ms', 99.0, 4000.0))\n"
         "def _gpt_train_step(batch=8, seq=1024, **cfg_overrides):\n"
         "    pass\n"
         "def bench_ok():\n"
@@ -292,9 +304,23 @@ def _expect_bench(findings):
     assert any("optimizer.'zero_stage'" in f.message
                and "BENCH_CONFIGS.json" in f.where for f in unknown)
     assert any("hidden_dims" in f.message for f in unknown)
+    # the stated-SLO contract (PR 12): bad metric/quantile/threshold fire
+    slo = [f for f in unknown if "DECODE_SLO" in f.where]
+    assert any("'latency_ms'" in f.message for f in slo)
+    assert any("101.0" in f.message for f in slo)
+    assert any("threshold_ms" in f.message for f in slo)
+    assert not any("e2e_ms" in f.where for f in slo)  # the valid triple
     # valid keys in the same legs are NOT flagged
     assert not any("remat_policy" in f.message for f in unknown)
     assert not any("'zero'" in f.message for f in unknown)
+
+
+def test_slo_metric_mirror_pinned():
+    """rules_ast.SLO_METRICS is a jax-free mirror of the slo module's
+    latency vocabulary — they must never drift."""
+    from apex_tpu.analysis.rules_ast import SLO_METRICS
+    from apex_tpu.observability.slo import LATENCY_METRICS
+    assert SLO_METRICS == LATENCY_METRICS
 
 
 PLANTED = [
@@ -345,7 +371,8 @@ def test_documenting_fixes_metrics_doc(tmp_path):
     _write(tmp_path, "docs/OBSERVABILITY.md",
            "| `health/rogue_metric` | `health/<tree>/rogue_family` |\n"
            "| `perf/rogue_attribution` | `ckpt/rogue_bytes` |\n"
-           "| `serve/rogue_ms` |\n")
+           "| `serve/rogue_ms` | `serve/rogue_wait_ms` |\n"
+           "| `slo/rogue_goodput` |\n")
     findings, _ = rule_metrics_doc(str(tmp_path))
     assert not findings
 

@@ -66,21 +66,42 @@ def test_gpt_pretrain_elastic_checkpoint_and_resume(tmp_path):
     assert latest_step(str(tmp_path)) == 3
 
 
-def test_gpt_serve_runs():
+def test_gpt_serve_runs(tmp_path):
     """The serving demo: every request completes through the continuous
-    batcher and the serve/* surface is populated (docs/SERVING.md)."""
+    batcher, the serve/* surface is populated, and the
+    percentile/goodput summary (the bench_gpt_decode vocabulary) plus
+    the per-slot Chrome request trace come out (docs/SERVING.md)."""
     import gpt_serve
-    payload = gpt_serve.main(["--requests", "4", "--max-new-tokens", "4"])
+    trace_path = tmp_path / "req_trace.json"
+    payload = gpt_serve.main(["--requests", "4", "--max-new-tokens", "4",
+                              "--trace-out", str(trace_path)])
     results = payload["completions"]
     assert sorted(results) == list(range(4))
     for i, c in sorted(results.items()):
         assert len(c.tokens) == 1 + (4 * (i + 1)) // 2
         assert c.finish_reason == "length"
+        # completions carry the measured request latencies
+        assert c.queue_wait_ms >= 0.0
+        assert c.ttft_ms >= c.queue_wait_ms
+        assert c.e2e_ms >= c.ttft_ms and c.tpot_ms > 0.0
     m = payload["metrics"]
     assert m["serve/admitted"] == 4.0 and m["serve/retired"] == 4.0
     assert m["serve/generated_tokens"] == sum(
         1 + (4 * (i + 1)) // 2 for i in range(4))
     assert m["serve/tokens_per_sec"] > 0.0
+    # the latency/SLO summary: p50 <= p95 <= p99, all measured
+    lat = payload["latency"]
+    for short in ("ttft", "tpot", "queue_wait", "e2e"):
+        p50, p95, p99 = (lat[f"{short}_p{q}_ms"] for q in (50, 95, 99))
+        assert 0.0 <= p50 <= p95 <= p99, short
+    assert lat["ttft_p50_ms"] > 0.0
+    assert 0.0 <= payload["goodput"] <= 1.0
+    assert payload["slo"] and "ttft_ms p95" in payload["slo"][0]
+    # the Chrome request trace is strict JSON with per-slot lanes
+    doc = json.loads(trace_path.read_text())
+    lanes = {e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert lanes == {"queue", "slot 0", "slot 1"}
 
 
 def test_dcgan_amp_runs():

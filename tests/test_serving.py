@@ -487,7 +487,14 @@ class TestSlotScheduler:
         for i, c in sorted(out.items()):
             assert c.finish_reason == "length"
             assert len(c.tokens) == 2 + i
+            # completions carry the measured request-lifecycle latencies
+            # (the full tracing/SLO surface: tests/test_reqtrace.py)
+            assert c.queue_wait_ms >= 0.0
+            assert c.ttft_ms >= c.queue_wait_ms
+            assert c.e2e_ms >= c.ttft_ms and c.tpot_ms > 0.0
         snap = reg.snapshot()
+        assert snap["serve/ttft_ms_count"] == 5.0
+        assert snap["serve/e2e_ms_count"] == 5.0
         assert snap["serve/admitted"] == 5.0
         assert snap["serve/retired"] == 5.0
         assert snap["serve/prefill_tokens"] == 15.0
