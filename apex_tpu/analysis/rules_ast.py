@@ -21,7 +21,9 @@ Rule -> encoded bug class (details + allowlisting in docs/ANALYSIS.md):
 - ``ast-remat-names`` — a checkpoint-name tag literal outside the
   ``remat.CHECKPOINT_NAMES`` registry (no policy can save it).
 - ``ast-elastic-exits`` — a process exit under ``apex_tpu/elastic/``
-  outside the ``AutoResume.request_resume`` chokepoint.
+  outside the two blessed chokepoints: ``AutoResume.request_resume``
+  (the runner's preemption exit) and ``launch.py::_supervisor_exit``
+  (the supervisor CLI's exit-code propagation).
 - ``ast-bench-configs`` — a bench-config key that no longer names a real
   config dataclass field (the leg silently falls back to defaults).
 """
@@ -43,7 +45,8 @@ from apex_tpu.analysis.astlint import (PACKAGE, callee_name,
 __all__ = ["ANNOTATIONS", "ALLOWED_GATHER", "ALLOWED_SCATTER",
            "GRAD_SYNC_PREFIXES", "METRIC_PREFIXES", "EXEMPT_PREFIXES",
            "METRIC_CALLEES", "TAG_CALLEES", "REGISTRY_FILE", "ELASTIC_DIR",
-           "CHOKEPOINT_FILE", "CHOKEPOINT_FUNC", "CONFIG_CLASSES",
+           "CHOKEPOINT_FILE", "CHOKEPOINT_FUNC", "LAUNCH_FILE",
+           "LAUNCH_CHOKEPOINT_FUNC", "CONFIG_CLASSES",
            "SECTIONS", "SLO_METRICS", "DOC", "rule_annotations",
            "rule_collectives",
            "rule_metrics_doc", "rule_metric_families", "rule_remat_names",
@@ -219,7 +222,7 @@ DOC = os.path.join("docs", "OBSERVABILITY.md")
 # meta-lint requires every slash-prefixed name to belong somewhere.
 METRIC_PREFIXES = ("health/", "tp/", "amp/", "ddp/", "pipeline/",
                    "optim/", "zero/", "mem/", "perf/", "ckpt/", "resume/",
-                   "serve/", "slo/")
+                   "serve/", "slo/", "elastic/")
 
 # slash-prefixed families that are deliberately OUTSIDE the doc-table
 # contract: jax/* (the compile-storm counters install_compile_listeners
@@ -401,6 +404,11 @@ def rule_remat_names(repo: str) -> Findings:
 ELASTIC_DIR = _p(PACKAGE, "elastic")
 CHOKEPOINT_FILE = _p(PACKAGE, "utils", "autoresume.py")
 CHOKEPOINT_FUNC = "request_resume"
+# the supervisor CLI (elastic/launch.py) needs a SECOND blessed exit —
+# it must propagate the gang's success as a process exit code — pinned,
+# exactly like the runner's, to one named chokepoint function
+LAUNCH_FILE = _p(PACKAGE, "elastic", "launch.py")
+LAUNCH_CHOKEPOINT_FUNC = "_supervisor_exit"
 
 
 def _exit_spelling(node):
@@ -421,6 +429,16 @@ def _exit_spelling(node):
     return None
 
 
+def _launch_chokepoint_nodes(tree) -> set:
+    """ids of every AST node inside ``LAUNCH_CHOKEPOINT_FUNC`` defs."""
+    inside = set()
+    for func in ast.walk(tree):
+        if isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and func.name == LAUNCH_CHOKEPOINT_FUNC:
+            inside.update(id(n) for n in ast.walk(func))
+    return inside
+
+
 def rule_elastic_exits(repo: str) -> Findings:
     findings, notes = [], []
     pkg = os.path.join(repo, ELASTIC_DIR)
@@ -432,17 +450,41 @@ def rule_elastic_exits(repo: str) -> Findings:
         tree = parse_file(path, rel)
         if tree is None:
             continue
+        is_launch = rel == LAUNCH_FILE
+        blessed = _launch_chokepoint_nodes(tree) if is_launch else set()
         clean = True
+        blessed_exits = 0
         for node in ast.walk(tree):
             spelling = _exit_spelling(node)
-            if spelling is not None:
+            if spelling is None:
+                continue
+            if id(node) in blessed:
+                # the supervisor CLI's one sanctioned exit; counted and
+                # shape-checked below, never reported as a raw EXIT
+                if spelling == "sys.exit":
+                    blessed_exits += 1
+                    continue
+            clean = False
+            findings.append(Finding(
+                "ast-elastic-exits", "EXIT", f"{rel}:{node.lineno}",
+                f"{spelling}: elastic code must exit only through "
+                f"AutoResume.{CHOKEPOINT_FUNC}"
+                + (f" or {LAUNCH_CHOKEPOINT_FUNC} (the supervisor CLI "
+                   f"chokepoint)" if is_launch else "")
+                + " — raise instead, so failures stay distinguishable "
+                  "from clean preemptions"))
+        if is_launch:
+            # chokepoint-rot check, mirroring the AutoResume one: the
+            # blessed function must hold EXACTLY one sys.exit
+            if blessed_exits != 1:
                 clean = False
                 findings.append(Finding(
-                    "ast-elastic-exits", "EXIT", f"{rel}:{node.lineno}",
-                    f"{spelling}: elastic code must exit only through "
-                    f"AutoResume.{CHOKEPOINT_FUNC} — raise instead, so "
-                    f"failures stay distinguishable from clean "
-                    f"preemptions"))
+                    "ast-elastic-exits", "CHOKE", rel,
+                    f"expected exactly one sys.exit inside "
+                    f"{LAUNCH_CHOKEPOINT_FUNC}, found {blessed_exits}"))
+            else:
+                notes.append(f"ok       {rel}::{LAUNCH_CHOKEPOINT_FUNC} "
+                             f"is the supervisor exit chokepoint")
         if clean:
             notes.append(f"ok       {rel}")
 
@@ -728,8 +770,8 @@ register(Rule("ast-remat-names", "ast",
               "remat.CHECKPOINT_NAMES; SELECTIVE_SAVE is a registry "
               "subset", run=rule_remat_names))
 register(Rule("ast-elastic-exits", "ast",
-              "elastic code exits only through "
-              "AutoResume.request_resume", run=rule_elastic_exits))
+              "elastic code exits only through AutoResume.request_resume "
+              "or launch.py::_supervisor_exit", run=rule_elastic_exits))
 register(Rule("ast-bench-configs", "ast",
               "bench-config keys name real config dataclass fields",
               run=rule_bench_configs))

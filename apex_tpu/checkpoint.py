@@ -49,8 +49,8 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["save_checkpoint", "restore_checkpoint", "latest_step",
-           "all_steps", "torn_steps"]
+__all__ = ["save_checkpoint", "restore_checkpoint", "read_host_state",
+           "latest_step", "all_steps", "torn_steps"]
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
 _HOST_FILE = "host.json"
@@ -114,6 +114,25 @@ def _step_dir(directory: str, step: int) -> str:
     return os.path.join(directory, f"step_{step:08d}")
 
 
+def _barrier(name: str) -> None:
+    """Cross-process sync point, a no-op in a single-process world.
+
+    Multi-controller checkpointing needs two of these around the
+    COMMITTED protocol: the orbax array save is collective (every process
+    writes the shards it owns) but each process's ``save`` returns after
+    only ITS shards are durable — without a barrier, process 0 could
+    write COMMITTED while another process's shards are still in flight
+    (a kill in that window yields the one thing the protocol promises
+    never to produce: a COMMITTED-but-partial checkpoint), and a
+    non-lead process could return from ``save_checkpoint`` and proceed to
+    a restore before the marker exists (observed live as a spurious
+    torn-dir fallback on the 2-process localhost mesh)."""
+    import jax
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+
+
 def all_steps(directory: str) -> list:
     """Committed checkpoint steps in ``directory``, ascending."""
     if not os.path.isdir(directory):
@@ -164,12 +183,11 @@ def save_checkpoint(directory: str, state: Any, step: int, *,
     Multi-host: the orbax array save is collective (every process calls
     ``save_checkpoint`` and writes the shards it owns); the directory
     bookkeeping here (rmtree/mkdir, host.json, COMMITTED marker, pruning)
-    runs only on process 0. A barrier after the collective save is orbax's
-    own ``wait_until_finished`` per process; COMMITTED is written by
-    process 0 after its local wait, which assumes the single-controller
-    deployment where process 0 finishes last or the filesystem tolerates
-    late shard writes — for strict multi-controller semantics add an
-    external barrier before relying on the marker.
+    runs only on process 0, fenced by cross-process barriers
+    (:func:`_barrier`): begin (no writer enters a dir the lead is still
+    clearing), arrays-durable (COMMITTED cannot precede any process's
+    shards), and commit (no process returns before the marker is
+    visible). All three are no-ops in a single-process world.
     """
     import orbax.checkpoint as ocp
 
@@ -187,12 +205,17 @@ def save_checkpoint(directory: str, state: Any, step: int, *,
         if os.path.exists(path):
             shutil.rmtree(path)
         os.makedirs(path, exist_ok=True)
+    # non-lead processes must not enter the collective save while the
+    # lead is still clearing a previous generation of this step dir
+    _barrier(f"apex_ckpt_begin_{step}")
 
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(os.path.join(path, "state"),
                    _to_storage(state, fp32_on_disk))
         ckptr.wait_until_finished()
 
+    # every process's shards are durable before COMMITTED can exist
+    _barrier(f"apex_ckpt_arrays_{step}")
     if lead:
         meta = {"step": int(step), "fp32_on_disk": bool(fp32_on_disk),
                 "host_state": host_state if host_state is not None else {}}
@@ -211,7 +234,33 @@ def save_checkpoint(directory: str, state: Any, step: int, *,
             steps = all_steps(directory)
             for old in steps[:max(len(steps) - keep_last, 0)]:
                 shutil.rmtree(_step_dir(directory, old), ignore_errors=True)
+    # no process returns before the marker is visible: the very next
+    # thing a caller may do is resolve latest_step for a restore
+    _barrier(f"apex_ckpt_commit_{step}")
     return path
+
+
+def read_host_state(directory: str, step: Optional[int] = None
+                    ) -> Tuple[int, Dict[str, Any]]:
+    """``(step, host_state)`` of the checkpoint at ``step`` (default:
+    latest COMMITTED) **without restoring any arrays** — the first half
+    of the cross-world-size restore path: an elastic restart peeks at the
+    saved world geometry (``host_state["world"]``, written by
+    :class:`~apex_tpu.elastic.runner.ElasticRunner`) here to decide
+    whether the on-disk ZeRO shard layout must be re-partitioned before
+    it can build the orbax restore target at all (the saved flat-shard
+    global shapes are a function of the OLD dp)."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(
+                f"no committed checkpoint under {directory!r}")
+    path = _step_dir(directory, step)
+    if not os.path.exists(os.path.join(path, _COMMIT_FILE)):
+        raise FileNotFoundError(f"checkpoint at {path!r} is not committed")
+    with open(os.path.join(path, _HOST_FILE)) as f:
+        meta = json.load(f)
+    return int(step), meta.get("host_state", {})
 
 
 def restore_checkpoint(directory: str, target: Any,

@@ -88,6 +88,11 @@ class ParallelConfig:
     pipeline_model_parallel_size: int = 1
     virtual_pipeline_model_parallel_size: Optional[int] = None
     context_parallel_size: int = 1
+    # multi-host layout rule (parallel_state._dcn_device_grid): lay the
+    # data axis outermost over the process (DCN) dimension, tp/pp/cp
+    # strictly intra-process. None = auto (on exactly when the device
+    # set spans >1 process); explicit True/False overrides.
+    dcn_data_parallel: Optional[bool] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -379,4 +384,5 @@ class TrainConfig:
             virtual_pipeline_model_parallel_size=
             self.parallel.virtual_pipeline_model_parallel_size,
             context_parallel_size=self.parallel.context_parallel_size,
-            devices=devices)
+            devices=devices,
+            dcn_data_parallel=self.parallel.dcn_data_parallel)

@@ -18,9 +18,17 @@ The production run-loop layer over :class:`~apex_tpu.training
 - :mod:`~apex_tpu.elastic.data` — seeded per-host sharded index
   iteration with a checkpointable cursor and double-buffered
   ``device_put`` prefetch.
+- :mod:`~apex_tpu.elastic.launch` — the localhost multi-process
+  launcher + elastic supervisor: heartbeat liveness, gang teardown,
+  bounded restart-with-backoff, and world-size **shrink** when a
+  process death is permanent (``elastic/*`` metrics).
+- :mod:`~apex_tpu.elastic.reshard` — the cross-world-size restore math:
+  bucket-major ZeRO flat shards re-partitioned dp_old → dp_new,
+  element-identically on the natural flat-vector content.
 
 See ``docs/ROBUSTNESS.md`` for the checkpoint format, the preemption
-walkthrough, and the bitwise-resume contract.
+walkthrough, the bitwise-resume contract, and the multi-host
+(coordinator bootstrap / heartbeat / shrink-resume) protocol.
 """
 
 from apex_tpu.elastic.ckpt import (AsyncCheckpointer, host_snapshot,
@@ -29,8 +37,13 @@ from apex_tpu.elastic.data import (PrefetchingIterator,
                                    ShardedIndexIterator,
                                    token_batch_fetcher)
 from apex_tpu.elastic.faults import FaultPlan
-from apex_tpu.elastic.runner import ElasticRunner, FitResult
+from apex_tpu.elastic.launch import (Heartbeat, LaunchReport,
+                                     LocalLauncher, RoundResult)
+from apex_tpu.elastic.runner import (DrainInterrupt, ElasticRunner,
+                                     FitResult)
 
-__all__ = ["AsyncCheckpointer", "ElasticRunner", "FaultPlan", "FitResult",
-           "PrefetchingIterator", "ShardedIndexIterator", "host_snapshot",
-           "owned_copy", "snapshot_nbytes", "token_batch_fetcher"]
+__all__ = ["AsyncCheckpointer", "DrainInterrupt", "ElasticRunner",
+           "FaultPlan", "FitResult", "Heartbeat", "LaunchReport",
+           "LocalLauncher", "PrefetchingIterator", "RoundResult",
+           "ShardedIndexIterator", "host_snapshot", "owned_copy",
+           "snapshot_nbytes", "token_batch_fetcher"]

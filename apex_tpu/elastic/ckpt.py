@@ -102,10 +102,24 @@ def owned_copy(state: Any) -> Any:
 
 
 def snapshot_nbytes(snapshot: Any) -> int:
-    """Total bytes of a host snapshot (the serialized payload scale)."""
-    return int(sum(np.asarray(leaf).nbytes
-                   for leaf in jax.tree_util.tree_leaves(snapshot)
-                   if hasattr(leaf, "nbytes") or hasattr(leaf, "dtype")))
+    """Total bytes of a snapshot (the serialized payload scale). Works
+    on host snapshots AND live global arrays (collective mode cannot
+    ``np.asarray`` a shard another process owns — ``.nbytes`` is global
+    metadata and needs no transfer)."""
+
+    def leaf_nbytes(leaf) -> int:
+        try:
+            return int(leaf.nbytes)
+        except Exception:
+            pass
+        try:
+            return int(np.asarray(leaf).nbytes)
+        except Exception:
+            return 0
+
+    return sum(leaf_nbytes(leaf)
+               for leaf in jax.tree_util.tree_leaves(snapshot)
+               if hasattr(leaf, "nbytes") or hasattr(leaf, "dtype"))
 
 
 class AsyncCheckpointer:
@@ -127,22 +141,77 @@ class AsyncCheckpointer:
     writer thread after a successful commit (fault plans use it to tear
     markers; production code normally leaves it unset). ``save_fn``
     overrides the serializer (tests substitute slow/counting stand-ins).
+
+    **Retry backoff**: attempt ``a`` sleeps
+    ``min(retry_backoff_cap_s, retry_backoff_s * 2**(a-1))`` scaled by
+    ``1 + retry_jitter * u`` with ``u ~ U[0, 1)`` drawn from a
+    ``RandomState`` seeded on ``(host_id, step)`` — N hosts retrying a
+    flaky shared filesystem in LOCKSTEP are a thundering herd that
+    re-breaks it on every attempt; per-host jitter decorrelates them,
+    and the host_id seed keeps every test (and every rank's schedule)
+    deterministic. ``backoff_s`` is the legacy spelling of
+    ``retry_backoff_s``.
+
+    **Collective mode** (``collective=True``): for multi-controller
+    worlds, where ``jax.device_get`` cannot snapshot non-addressable
+    shards — ``save`` serializes *synchronously* on the calling thread,
+    handing the live sharded state straight to the collective
+    :func:`~apex_tpu.checkpoint.save_checkpoint` (each process writes
+    the shards it owns; the COMMITTED protocol is fenced by
+    cross-process barriers there). The async split is a single-host
+    optimization; the interface (save/drain/metrics) is unchanged so
+    :class:`~apex_tpu.elastic.runner.ElasticRunner` is world-size
+    agnostic. Collective saves never retry (``max_retries`` is ignored):
+    an asymmetric transient failure would have one rank re-entering the
+    begin barrier while its peers wait in the arrays barrier — a gang
+    deadlock. A failed collective save raises; recovery is the
+    supervisor's gang restart from the last COMMITTED generation.
     """
 
     def __init__(self, directory: str, *, fp32_on_disk: bool = True,
                  keep_last: Optional[int] = None, max_retries: int = 3,
-                 backoff_s: float = 0.05,
+                 backoff_s: Optional[float] = None,
+                 retry_backoff_s: Optional[float] = None,
+                 retry_backoff_cap_s: Optional[float] = None,
+                 retry_jitter: float = 0.25,
+                 host_id: Optional[int] = None,
+                 collective: bool = False,
                  registry: Optional[MetricsRegistry] = None,
                  fault_hook: Optional[Callable[[int, int], None]] = None,
                  after_save: Optional[Callable[[int, str], None]] = None,
                  save_fn: Optional[Callable[..., str]] = None):
         if max_retries < 0:
             raise ValueError("max_retries must be >= 0")
+        if (backoff_s is not None and retry_backoff_s is not None
+                and backoff_s != retry_backoff_s):
+            raise ValueError(
+                f"backoff_s={backoff_s} and retry_backoff_s="
+                f"{retry_backoff_s} are the same parameter spelled "
+                f"twice; pass only retry_backoff_s")
+        if retry_backoff_s is None:
+            retry_backoff_s = 0.05 if backoff_s is None else backoff_s
+        if retry_backoff_cap_s is None:
+            # the default cap must not invalidate a legal base — a
+            # legacy backoff_s=60.0 predates the cap and keeps working
+            retry_backoff_cap_s = max(30.0, retry_backoff_s)
+        elif retry_backoff_cap_s < retry_backoff_s:
+            raise ValueError(
+                f"retry_backoff_cap_s={retry_backoff_cap_s} below the "
+                f"base retry_backoff_s={retry_backoff_s}")
+        if retry_jitter < 0.0:
+            raise ValueError("retry_jitter must be >= 0")
         self.directory = directory
         self.fp32_on_disk = fp32_on_disk
         self.keep_last = keep_last
         self.max_retries = max_retries
-        self.backoff_s = backoff_s
+        self.retry_backoff_s = retry_backoff_s
+        self.retry_backoff_cap_s = retry_backoff_cap_s
+        self.retry_jitter = retry_jitter
+        if host_id is None:
+            from apex_tpu.parallel.multiproc import process_id
+            host_id = process_id()
+        self.host_id = int(host_id)
+        self.collective = collective
         self.fault_hook = fault_hook
         self.after_save = after_save
         self._save_fn = save_fn or _ckpt.save_checkpoint
@@ -157,14 +226,43 @@ class AsyncCheckpointer:
         self._m_retries = reg.counter("ckpt/retries")
         self._m_inflight.set(0)
 
+    @property
+    def backoff_s(self) -> float:
+        """Legacy alias of ``retry_backoff_s``."""
+        return self.retry_backoff_s
+
+    def _backoff_sleep_s(self, step: int, attempt: int) -> float:
+        """Deterministic jittered backoff before retry ``attempt``
+        (1-based) of the save at ``step``."""
+        base = min(self.retry_backoff_cap_s,
+                   self.retry_backoff_s * (2.0 ** (attempt - 1)))
+        if self.retry_jitter <= 0.0:
+            return base
+        rs = np.random.RandomState(
+            (self.host_id * 1_000_003 + step * 7919 + 1) % (2 ** 32))
+        u = float(rs.uniform(0.0, 1.0, size=attempt)[-1])
+        return base * (1.0 + self.retry_jitter * u)
+
     # -- writer side ------------------------------------------------------
     def _serialize(self, snapshot: Any, step: int,
                    host_state: Optional[Dict[str, Any]]) -> None:
         last: Optional[BaseException] = None
-        for attempt in range(self.max_retries + 1):
+        # collective mode NEVER retries: the collective save is fenced
+        # by named cross-process barriers, and an ASYMMETRIC transient
+        # failure (one rank errors out of the orbax write while its
+        # peers sit in the arrays-durable barrier) would have the
+        # retrying rank re-enter the begin barrier while the others wait
+        # in a different one — a gang deadlock the supervisor can only
+        # break by teardown. Fail the save loudly instead; multi-host
+        # recovery is the supervisor's restart-from-last-COMMITTED, not
+        # an in-process retry. (Per-host retry-with-jitter remains the
+        # single-controller path's tool.)
+        retry_budget = 0 if self.collective else self.max_retries
+        for attempt in range(retry_budget + 1):
             if attempt:
-                # bounded exponential backoff between transient failures
-                time.sleep(self.backoff_s * (2.0 ** (attempt - 1)))
+                # bounded exponential backoff between transient
+                # failures, host-decorrelated by deterministic jitter
+                time.sleep(self._backoff_sleep_s(step, attempt))
                 self._m_retries.inc()
             try:
                 if self.fault_hook is not None:
@@ -184,7 +282,11 @@ class AsyncCheckpointer:
                 last = e
         raise OSError(
             f"checkpoint save at step {step} failed after "
-            f"{self.max_retries + 1} attempt(s)") from last
+            f"{retry_budget + 1} attempt(s)"
+            + (" (collective saves never retry — an asymmetric retry "
+               "would deadlock the barrier protocol; recovery is the "
+               "supervisor's restart from the last COMMITTED "
+               "checkpoint)" if self.collective else "")) from last
 
     def _run(self, snapshot: Any, step: int,
              host_state: Optional[Dict[str, Any]]) -> None:
@@ -209,7 +311,18 @@ class AsyncCheckpointer:
         write is in flight and a failure surfaces within one save
         interval. ``block=True`` additionally waits for THIS save (the
         final/preemption save path).
+
+        In ``collective`` mode the save is synchronous and collective:
+        no snapshot (``device_get`` cannot see other processes' shards),
+        no thread (every process must be inside the orbax save and its
+        barriers at the same time) — the live state goes straight to the
+        serializer and this call returns only after COMMITTED is
+        visible.
         """
+        if self.collective:
+            self._m_bytes.inc(snapshot_nbytes(state))
+            self._serialize(state, step, host_state)
+            return
         self.drain()
         snapshot = host_snapshot(state)
         self._m_bytes.inc(snapshot_nbytes(snapshot))

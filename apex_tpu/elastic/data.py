@@ -107,15 +107,63 @@ class ShardedIndexIterator:
 
     # -- resume -----------------------------------------------------------
     def state_dict(self) -> Dict[str, int]:
-        return {"consumed": int(self.consumed), "seed": self.seed}
+        return {"consumed": int(self.consumed), "seed": self.seed,
+                "num_hosts": self.num_hosts,
+                "global_batch": self.global_batch}
 
-    def load_state_dict(self, state: Dict[str, int]) -> None:
+    def _check_stream_identity(self, state: Dict[str, int]) -> None:
+        """The fields that define the GLOBAL stream — any mismatch means
+        the cursor indexes a different sequence and no reseek can fix
+        it."""
         seed = state.get("seed")
         if seed is not None and int(seed) != self.seed:
             raise ValueError(
                 f"data cursor was saved under seed {seed} but this "
                 f"iterator is seeded with {self.seed}; resuming would "
                 f"replay a different stream")
+        gb = state.get("global_batch")
+        if gb is not None and int(gb) != self.global_batch:
+            raise ValueError(
+                f"data cursor was saved with global_batch {gb} but this "
+                f"iterator batches {self.global_batch} rows globally; "
+                f"the cursor counts batches of the SAVED size, so "
+                f"resuming would skip or replay rows. Keep the global "
+                f"batch fixed across world-size changes (scale the "
+                f"microbatch count instead).")
+
+    def load_state_dict(self, state: Dict[str, int]) -> None:
+        """Same-world restore. A cursor saved under a **different**
+        ``num_hosts`` is rejected loudly: the per-host slice of every
+        global batch is a function of ``(host_id, num_hosts)``, so a
+        stale cursor would silently shift which rows each host consumes
+        (rows double-consumed on some hosts, skipped on others). A
+        world-size change must go through :meth:`reseek`, which
+        re-derives this host's slices from the new grid."""
+        self._check_stream_identity(state)
+        hosts = state.get("num_hosts")
+        if hosts is not None and int(hosts) != self.num_hosts:
+            raise ValueError(
+                f"data cursor was saved under num_hosts={hosts} but this "
+                f"iterator shards for num_hosts={self.num_hosts}. "
+                f"Loading it as-is would silently shift which rows each "
+                f"host consumes (the per-host slice is a function of the "
+                f"host grid). If the world size changed on purpose "
+                f"(elastic shrink/grow), call it.reseek(state): batch k "
+                f"is a pure function of (seed, k), so the GLOBAL sample "
+                f"sequence is preserved and the new grid just re-slices "
+                f"it.")
+        self.consumed = int(state["consumed"])
+
+    def reseek(self, state: Dict[str, int]) -> None:
+        """The world-size-change restore path (elastic shrink/grow):
+        accept a cursor saved under a different ``num_hosts``. Safe
+        because the cursor is GLOBAL (batches consumed) and
+        :meth:`batch_indices` is pure in ``(seed, k)`` — the global
+        sample sequence continues exactly where the old world left it
+        (no row skipped or double-consumed); only the per-host slicing
+        of each batch follows the new grid. Stream identity (seed,
+        global_batch) must still match."""
+        self._check_stream_identity(state)
         self.consumed = int(state["consumed"])
 
 
@@ -164,14 +212,29 @@ class PrefetchingIterator:
         self._fill()  # keep the pipeline primed while the step runs
         return batch
 
+    @property
+    def num_hosts(self) -> int:
+        return self.sampler.num_hosts
+
     # -- resume -----------------------------------------------------------
     def state_dict(self) -> Dict[str, int]:
         # the CONSUMED cursor: prefetched-but-unconsumed batches are
-        # in-flight state the restore deliberately refetches
-        return {"consumed": int(self.consumed), "seed": self.sampler.seed}
+        # in-flight state the restore deliberately refetches. The
+        # sampler's grid identity (seed/num_hosts/global_batch) rides
+        # along so a restore into a different world is caught loudly.
+        state = self.sampler.state_dict()
+        state["consumed"] = int(self.consumed)
+        return state
 
     def load_state_dict(self, state: Dict[str, int]) -> None:
         self.sampler.load_state_dict(state)  # seeks sampler.consumed too
+        self.consumed = int(state["consumed"])
+        self._buf.clear()
+
+    def reseek(self, state: Dict[str, int]) -> None:
+        """World-size-change restore: see
+        :meth:`ShardedIndexIterator.reseek`."""
+        self.sampler.reseek(state)
         self.consumed = int(state["consumed"])
         self._buf.clear()
 

@@ -19,6 +19,17 @@ preemption and filesystem faults are a tested path, not a hope:
 - ``slow_save_s=t`` — stretch every serialization by ``t`` seconds, to
   widen the in-flight window deterministically (so a preemption reliably
   lands while a save is being written).
+- ``kill_process={rank: K}`` — the multi-host failure domain: deliver
+  ``SIGKILL`` (not SIGTERM — no drain, no final save, no grace window)
+  to THIS process right before its step ``K``, but only when this
+  process's multiproc rank (:func:`apex_tpu.parallel.multiproc
+  .process_id`) is ``rank``. The whole-process murder the elastic
+  supervisor (:mod:`apex_tpu.elastic.launch`) must detect, and the
+  survivors must shrink-resume from. After a shrink, surviving ranks
+  are relabeled ``0..world-1``; key the kill on a NON-ZERO rank so the
+  shrunk world does not re-trigger it (and rank 0 usually hosts the
+  rendezvous coordinator — killing it tests the coordinator, not a
+  worker).
 
 Plans are *explicitly seeded* and fully serializable: :meth:`sample`
 derives one from an integer seed via ``numpy.random.RandomState`` (no
@@ -50,13 +61,22 @@ class FaultPlan:
     save_errors: Dict[int, int] = dataclasses.field(default_factory=dict)
     tear_after_step: Optional[int] = None
     slow_save_s: float = 0.0
+    kill_process: Dict[int, int] = dataclasses.field(default_factory=dict)
     seed: Optional[int] = None  # provenance when built via sample()
 
     # -- injection hooks --------------------------------------------------
     def before_step(self, step: int) -> None:
         """Runner hook, called before step ``step`` executes. Delivers
         the scripted SIGTERM to *this* process — through the real signal
-        machinery, so the AutoResume handler path is the one exercised."""
+        machinery, so the AutoResume handler path is the one exercised.
+        ``kill_process`` entries deliver SIGKILL instead (a hard
+        whole-process death) when this process's multiproc rank
+        matches."""
+        if self.kill_process:
+            from apex_tpu.parallel.multiproc import process_id
+            k = self.kill_process.get(process_id())
+            if k is not None and step == k:
+                os.kill(os.getpid(), signal.SIGKILL)
         if self.sigterm_at_step is not None and step == self.sigterm_at_step:
             os.kill(os.getpid(), signal.SIGTERM)
 
@@ -117,6 +137,8 @@ class FaultPlan:
     def to_json(self) -> str:
         d = dataclasses.asdict(self)
         d["save_errors"] = {str(k): v for k, v in self.save_errors.items()}
+        d["kill_process"] = {str(k): v
+                             for k, v in self.kill_process.items()}
         return json.dumps(d)
 
     @classmethod
@@ -124,4 +146,6 @@ class FaultPlan:
         d = json.loads(text)
         d["save_errors"] = {int(k): int(v)
                             for k, v in d.get("save_errors", {}).items()}
+        d["kill_process"] = {int(k): int(v)
+                             for k, v in d.get("kill_process", {}).items()}
         return cls(**d)

@@ -1,0 +1,406 @@
+"""Localhost multi-process launcher + elastic supervisor.
+
+The supervisor half of the multi-host runtime (the worker half —
+rendezvous env protocol, ``jax.distributed.initialize`` bootstrap — is
+:mod:`apex_tpu.parallel.multiproc`). :class:`LocalLauncher` spawns a
+gang of ``num_processes`` worker processes (each driving its own
+``devices_per_process`` virtual CPU devices: the 2-process x 4-device
+localhost simulation of a multi-host TPU slice) and supervises them
+through the elastic policy docs/ROBUSTNESS.md specifies:
+
+- **heartbeats** — each worker touches ``run_dir/hb/rank_<r>`` every
+  step (:class:`Heartbeat`); the supervisor treats a stale heartbeat as
+  a hung rank (a SIGKILLed peer leaves survivors stuck inside gloo
+  collectives — observed live — so liveness cannot be inferred from
+  process exit alone).
+- **gang failure domain** — ranks of one jax.distributed world share a
+  coordinator and open collectives; one rank's death poisons the rest
+  (coordination-service abort or a gloo connection error at the next
+  collective). The supervisor therefore tears down the WHOLE gang on any
+  failure (SIGTERM, grace, then SIGKILL — survivors stuck in native
+  collectives ignore SIGTERM) and relaunches it with a fresh coordinator
+  port; relaunched workers resume from the last COMMITTED checkpoint.
+- **bounded restart-with-backoff** — up to ``max_restarts`` relaunches
+  at the SAME world size (transient deaths: OOM-kill, spurious runtime
+  abort), with exponential backoff between rounds.
+- **shrink** — when the restart budget at a world size is exhausted, the
+  failure is declared permanent and the gang relaunches with ``world-1``
+  processes (ranks relabel ``0..world-1``). Survivors restore the last
+  COMMITTED checkpoint onto the smaller mesh — the dp-reshard path in
+  :mod:`apex_tpu.elastic.runner` / :mod:`apex_tpu.elastic.reshard` —
+  and continue the run. Exhausting the policy below ``min_processes``
+  returns ``LaunchReport(succeeded=False)`` (CLI exit 1); exceptions
+  are reserved for supervisor bugs.
+
+Metrics (host registry, docs/OBSERVABILITY.md): ``elastic/world_size``
+(gauge), ``elastic/restarts`` / ``elastic/shrinks`` (counters),
+``elastic/heartbeat_age_s`` (gauge, max staleness over live ranks).
+
+Exit discipline: :func:`_supervisor_exit` is the ONE blessed process
+exit in this package besides ``AutoResume.request_resume`` — the CLI
+must propagate the gang's success as an exit code, and the
+``ast-elastic-exits`` analysis rule pins it to exactly this chokepoint
+(everything else raises, so supervisor bugs stay distinguishable from
+worker failures).
+
+CLI: ``python -m apex_tpu.elastic.launch -n 2 -- python worker.py ...``
+(also reachable as ``python -m apex_tpu.parallel.multiproc``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from apex_tpu.observability.registry import MetricsRegistry, get_registry
+from apex_tpu.parallel import multiproc
+
+__all__ = ["Heartbeat", "LaunchReport", "LocalLauncher", "RoundResult",
+           "main"]
+
+_HB_DIR = "hb"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class Heartbeat:
+    """File-mtime heartbeat between one worker rank and the supervisor.
+
+    Worker side: ``Heartbeat(run_dir).beat(step)`` each step (atomic
+    tmp+rename write of ``"<step> <unix_time>"``). Supervisor side:
+    :meth:`age_s` reads staleness off the file mtime — no shared memory,
+    no sockets, works across SIGKILL (the file outlives the writer, so
+    the supervisor can also read :meth:`last_step` of a dead rank when
+    deciding what the restart will resume from).
+    """
+
+    def __init__(self, run_dir: str, rank: Optional[int] = None):
+        if rank is None:
+            rank = multiproc.process_id()
+        self.rank = int(rank)
+        self.path = os.path.join(run_dir, _HB_DIR, f"rank_{self.rank}")
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+
+    def beat(self, step: int = 0) -> None:
+        tmp = f"{self.path}.tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{int(step)} {time.time()}\n")
+        os.replace(tmp, self.path)
+
+    @staticmethod
+    def age_s(run_dir: str, rank: int,
+              default: Optional[float] = None) -> Optional[float]:
+        """Seconds since rank ``rank`` last beat; ``default`` when it
+        never has. Wall-clock (mtime-based) — a debugging convenience;
+        the supervisor's hang detection uses the mtime only as a change
+        detector and ages with monotonic deltas
+        (:meth:`LocalLauncher._heartbeat_age`), so a system clock step
+        cannot fake staleness there."""
+        path = os.path.join(run_dir, _HB_DIR, f"rank_{rank}")
+        try:
+            return max(0.0, time.time() - os.stat(path).st_mtime)
+        except OSError:
+            return default
+
+    @staticmethod
+    def last_step(run_dir: str, rank: int) -> Optional[int]:
+        path = os.path.join(run_dir, _HB_DIR, f"rank_{rank}")
+        try:
+            with open(path) as f:
+                return int(f.read().split()[0])
+        except (OSError, ValueError, IndexError):
+            return None
+
+    @staticmethod
+    def clear(run_dir: str) -> None:
+        """Remove every rank's heartbeat (between rounds: a stale file
+        from the previous gang must not vouch for the new one)."""
+        shutil.rmtree(os.path.join(run_dir, _HB_DIR), ignore_errors=True)
+
+
+@dataclasses.dataclass
+class RoundResult:
+    """One gang launch: its world size, every rank's exit code (negative
+    = killed by that signal; ``None`` never materializes — teardown
+    always reaps), and why the round ended."""
+
+    world_size: int
+    returncodes: Dict[int, int]
+    cause: str  # "ok" | "exit" | "heartbeat" | "timeout"
+
+
+@dataclasses.dataclass
+class LaunchReport:
+    """What :meth:`LocalLauncher.run` did end to end."""
+
+    succeeded: bool     # the gang completed (every rank exited 0)
+    world_size: int     # world size of the last round actually run
+    restarts: int       # same-world relaunches taken
+    shrinks: int        # world-size reductions taken
+    rounds: List[RoundResult]
+
+
+class LocalLauncher:
+    """Spawn + supervise a localhost multi-process worker gang.
+
+    ``worker_argv`` is the full worker command line; each rank gets it
+    verbatim plus the :mod:`~apex_tpu.parallel.multiproc` env block
+    (coordinator address on a fresh port per round, world size, rank,
+    ``devices_per_process``, ``run_dir``). Worker stdout/stderr stream to
+    ``run_dir/logs/round<k>_rank<r>.log``.
+
+    The policy knobs mirror the docstring above: ``max_restarts``
+    same-world relaunches (backoff ``restart_backoff_s * 2**k``), then
+    shrink by one process per permanent failure down to
+    ``min_processes``; ``heartbeat_timeout_s`` declares a silent rank
+    hung; ``round_timeout_s`` bounds a whole round; ``grace_s`` is the
+    SIGTERM→SIGKILL escalation window at teardown.
+    """
+
+    def __init__(self, worker_argv: Sequence[str], *, num_processes: int,
+                 run_dir: str, devices_per_process: int = 4,
+                 min_processes: int = 1, max_restarts: int = 1,
+                 restart_backoff_s: float = 0.5,
+                 heartbeat_timeout_s: float = 300.0,
+                 round_timeout_s: float = 900.0, grace_s: float = 5.0,
+                 poll_s: float = 0.05,
+                 env: Optional[Dict[str, str]] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        if num_processes < 1:
+            raise ValueError("num_processes must be >= 1")
+        if not 1 <= min_processes <= num_processes:
+            raise ValueError(
+                f"need 1 <= min_processes <= num_processes, got "
+                f"{min_processes}/{num_processes}")
+        if max_restarts < 0:
+            raise ValueError("max_restarts must be >= 0")
+        self.worker_argv = list(worker_argv)
+        self.num_processes = num_processes
+        self.devices_per_process = devices_per_process
+        self.run_dir = run_dir
+        self.min_processes = min_processes
+        self.max_restarts = max_restarts
+        self.restart_backoff_s = restart_backoff_s
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.round_timeout_s = round_timeout_s
+        self.grace_s = grace_s
+        self.poll_s = poll_s
+        self.env = env
+        reg = registry if registry is not None else get_registry()
+        self._m_world = reg.gauge("elastic/world_size")
+        self._m_restarts = reg.counter("elastic/restarts")
+        self._m_shrinks = reg.counter("elastic/shrinks")
+        self._m_hb_age = reg.gauge("elastic/heartbeat_age_s")
+        os.makedirs(os.path.join(run_dir, "logs"), exist_ok=True)
+
+    # -- one gang ---------------------------------------------------------
+    def _spawn(self, world: int, round_idx: int) -> List[subprocess.Popen]:
+        port = _free_port()  # fresh coordinator per round: the previous
+        # gang's service may still hold the old one in TIME_WAIT
+        procs = []
+        for rank in range(world):
+            env = dict(os.environ)
+            if self.env:
+                env.update(self.env)
+            env.update(multiproc.process_env(
+                rank, world, f"127.0.0.1:{port}",
+                local_devices=self.devices_per_process,
+                run_dir=self.run_dir))
+            log_path = os.path.join(self.run_dir, "logs",
+                                    f"round{round_idx}_rank{rank}.log")
+            with open(log_path, "ab") as log:
+                procs.append(subprocess.Popen(
+                    self.worker_argv, env=env, stdout=log,
+                    stderr=subprocess.STDOUT))
+        return procs
+
+    def _teardown(self, procs: List[subprocess.Popen]) -> None:
+        """Reap the whole gang: SIGTERM, grace, SIGKILL. The SIGKILL leg
+        is not optional politeness — a survivor of a dead peer sits
+        inside a native gloo collective and never services SIGTERM."""
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + self.grace_s
+        while time.monotonic() < deadline and any(
+                p.poll() is None for p in procs):
+            time.sleep(self.poll_s)
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+        for p in procs:
+            p.wait()
+
+    def _heartbeat_age(self, procs: List[subprocess.Popen],
+                       started: float, seen: Dict[int, tuple]) -> float:
+        """Max staleness over ranks still running; a rank that never
+        beat ages from the round start (it may be compiling — the
+        timeout budget covers first-compile).
+
+        The file mtime is used only as a CHANGE detector: ``seen`` maps
+        rank -> (last mtime observed, monotonic time of that
+        observation), and age is the monotonic delta since the mtime
+        last moved. Aging ``time.time() - st_mtime`` directly would mix
+        the wall clock into a monotonic budget — an NTP step or VM
+        suspend/resume larger than ``heartbeat_timeout_s`` would then
+        declare a perfectly healthy gang hung and tear it down."""
+        now = time.monotonic()
+        ages = []
+        for rank, p in enumerate(procs):
+            if p.poll() is not None:
+                continue
+            path = os.path.join(self.run_dir, _HB_DIR, f"rank_{rank}")
+            try:
+                mtime = os.stat(path).st_mtime
+            except OSError:
+                ages.append(now - started)  # never beat yet
+                continue
+            last = seen.get(rank)
+            if last is None or last[0] != mtime:
+                seen[rank] = (mtime, now)
+                ages.append(0.0)
+            else:
+                ages.append(now - last[1])
+        return max(ages) if ages else 0.0
+
+    def _run_round(self, world: int, round_idx: int) -> RoundResult:
+        Heartbeat.clear(self.run_dir)
+        procs = self._spawn(world, round_idx)
+        started = time.monotonic()
+        hb_seen: Dict[int, tuple] = {}
+        cause = "timeout"
+        try:
+            while True:
+                time.sleep(self.poll_s)
+                rcs = [p.poll() for p in procs]
+                if any(rc not in (None, 0) for rc in rcs):
+                    cause = "exit"
+                    break
+                if all(rc == 0 for rc in rcs):
+                    cause = "ok"
+                    break
+                age = self._heartbeat_age(procs, started, hb_seen)
+                self._m_hb_age.set(age)
+                if age > self.heartbeat_timeout_s:
+                    cause = "heartbeat"
+                    break
+                if time.monotonic() - started > self.round_timeout_s:
+                    cause = "timeout"
+                    break
+        finally:
+            self._teardown(procs)
+        return RoundResult(
+            world_size=world,
+            returncodes={r: p.returncode for r, p in enumerate(procs)},
+            cause=cause)
+
+    # -- the supervisor loop ----------------------------------------------
+    def run(self) -> LaunchReport:
+        """Launch and supervise until the gang completes (every rank
+        exits 0) or the elastic policy is exhausted (the world would
+        shrink below ``min_processes``). Policy exhaustion is an
+        OUTCOME, not a supervisor bug: it returns
+        ``LaunchReport(succeeded=False, ...)`` with the per-round
+        forensics (worker logs stay under ``run_dir/logs``), and the
+        CLI maps it to exit code 1 through ``_supervisor_exit`` —
+        exceptions out of ``run`` are reserved for real supervisor
+        failures."""
+        world = self.num_processes
+        restarts = shrinks = attempts_at_world = 0
+        rounds: List[RoundResult] = []
+        while True:
+            self._m_world.set(world)
+            result = self._run_round(world, len(rounds))
+            rounds.append(result)
+            if result.cause == "ok":
+                return LaunchReport(succeeded=True, world_size=world,
+                                    restarts=restarts, shrinks=shrinks,
+                                    rounds=rounds)
+            if attempts_at_world < self.max_restarts:
+                # transient-death policy: same world, backoff, relaunch
+                attempts_at_world += 1
+                restarts += 1
+                self._m_restarts.inc()
+                time.sleep(self.restart_backoff_s
+                           * (2.0 ** (attempts_at_world - 1)))
+                continue
+            # restart budget exhausted: the failure is permanent at this
+            # world size. A shrink is only a shrink if the smaller gang
+            # may actually launch — exhausting the policy AT
+            # min_processes must not count (or emit) a world-size
+            # reduction that never happened.
+            if world - 1 < self.min_processes:
+                return LaunchReport(
+                    succeeded=False, world_size=world,  # last world RUN
+                    restarts=restarts, shrinks=shrinks, rounds=rounds)
+            world -= 1
+            shrinks += 1
+            attempts_at_world = 0
+            self._m_shrinks.inc()
+
+
+def main(argv=None) -> int:
+    """CLI: ``python -m apex_tpu.elastic.launch -n N [opts] -- worker
+    cmd...``. Returns the process exit code (0 = the gang completed)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m apex_tpu.elastic.launch",
+        description="localhost multi-process elastic supervisor")
+    ap.add_argument("-n", "--num-processes", type=int, required=True)
+    ap.add_argument("--devices-per-process", type=int, default=4)
+    ap.add_argument("--run-dir", default=None)
+    ap.add_argument("--min-processes", type=int, default=1)
+    ap.add_argument("--max-restarts", type=int, default=1)
+    ap.add_argument("--heartbeat-timeout", type=float, default=300.0)
+    ap.add_argument("--round-timeout", type=float, default=900.0)
+    ap.add_argument("worker", nargs=argparse.REMAINDER,
+                    help="worker command line (prefix with --)")
+    args = ap.parse_args(argv)
+    # strip only the LEADING separator: a later "--" belongs to the
+    # worker's own command line and must pass through verbatim
+    worker = list(args.worker)
+    if worker and worker[0] == "--":
+        worker = worker[1:]
+    if not worker:
+        ap.error("missing worker command (pass it after --)")
+    import tempfile
+
+    run_dir = args.run_dir or tempfile.mkdtemp(prefix="apex_tpu_launch_")
+    launcher = LocalLauncher(
+        worker, num_processes=args.num_processes,
+        devices_per_process=args.devices_per_process, run_dir=run_dir,
+        min_processes=args.min_processes, max_restarts=args.max_restarts,
+        heartbeat_timeout_s=args.heartbeat_timeout,
+        round_timeout_s=args.round_timeout)
+    report = launcher.run()
+    return 0 if report.succeeded else 1
+
+
+def _supervisor_exit(code: int) -> None:
+    """The single blessed process exit of the supervisor CLI — the
+    ``ast-elastic-exits`` analysis rule pins ``sys.exit`` in this
+    package to exactly here (plus ``AutoResume.request_resume`` for the
+    runner's preemption path); every other failure must raise."""
+    sys.exit(int(code))
+
+
+if __name__ == "__main__":
+    _supervisor_exit(main())

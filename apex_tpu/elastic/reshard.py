@@ -1,0 +1,182 @@
+"""Cross-world-size resharding of bucket-major ZeRO flat shards.
+
+The elastic shrink contract (ROADMAP item 3, docs/ROBUSTNESS.md): when a
+process dies permanently, the survivors restore the last COMMITTED
+checkpoint onto a **smaller** mesh and continue. Replicated leaves
+(params, loss scale) restore as-is — their global shapes do not depend on
+dp. The ZeRO optimizer state does NOT: each device owns a flat fp32
+shard of the master params/moments whose *element order* is a function of
+the dp grid twice over —
+
+1. the padded flat length is ``ceil(total / dp) * dp`` (the layout pads
+   to a multiple of the shard count), and
+2. with bucketing, the shard is **bucket-major**: rank ``r``'s shard is
+   the concatenation over buckets ``b`` of bucket ``b``'s ``r``-th
+   ``1/dp`` slice (``optimizers/distributed_fused.py::_my_slice``), and
+   the bucket spans themselves are rounded to multiples of dp
+   (``optimizers/_flatten.bucket_bounds``).
+
+So a dp=4 checkpoint restored verbatim into a dp=2 world would not just
+be the wrong shape — trimmed or re-split it would silently permute every
+master/moment element. This module is the exact inverse+forward of that
+layout, built on the same span machinery: recover the **natural**
+(leaf-order) flat vector from the old grid's global array, then re-emit
+it in the new grid's bucket-major order. The round trip is a pure index
+permutation — element-identical, no arithmetic — which is what makes the
+shrink-resume parity guarantee provable (tier-1 asserts it on the
+flat-vector content; the multichip gate proves the end-to-end run).
+
+Axis layout: the trainer stores the ZeRO state sharded
+``P(("pipe", "data", "tensor"))`` along dim 0, pipe-major then data then
+tensor (``GPTHybridTrainer._zero_state_spec``). Every (pipe, tensor)
+coordinate is an independent flat vector with the SAME layout (stage
+stacks have identical per-rank shapes), so the global array reshapes to
+``(pp, dp, tp, chunk)`` and each of the ``pp*tp`` columns reshards
+independently.
+
+All functions are host-side numpy on fp32 vectors — resharding happens
+once per world-size change, between the orbax read and the device_put
+onto the new mesh, never inside a traced program.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from apex_tpu.optimizers._flatten import FlatLayout, bucket_bounds
+
+__all__ = ["flat_grid", "shard_permutation", "to_natural", "from_natural",
+           "reshard_flat", "reshard_zero_state"]
+
+
+def flat_grid(total: int, dp: int, bucket_bytes):
+    """``(padded, bounds)`` of a ``total``-element flat vector sharded
+    ``dp`` ways under ``bucket_bytes`` — the same grid
+    :func:`~apex_tpu.optimizers._flatten.bucket_bounds` serves the
+    optimizers, derived here from the two integers the checkpoint sidecar
+    records (``flat_total``, ``bucket_bytes``) instead of a live param
+    tree."""
+    if total < 1 or dp < 1:
+        raise ValueError(f"need total >= 1 and dp >= 1, got {total}/{dp}")
+    bucket_bytes = bucket_bytes or None  # sidecars spell monolithic as 0
+    padded = -(-total // dp) * dp
+    lay = FlatLayout(treedef=None, shapes=(), dtypes=(), sizes=(),
+                     offsets=(), total=total, padded=padded,
+                     chunk=padded // dp)
+    return padded, bucket_bounds(lay, bucket_bytes)
+
+
+def shard_permutation(total: int, dp: int, bucket_bytes) -> np.ndarray:
+    """Index map ``idx`` (length ``padded``) with
+    ``data_axis_global = natural_padded[idx]``: position ``p`` of the
+    dp-concatenated bucket-major global vector holds natural element
+    ``idx[p]``. Rank-major outer order (the data-axis concatenation),
+    bucket-major inner (``_my_slice``)."""
+    padded, bounds = flat_grid(total, dp, bucket_bytes)
+    idx = np.empty(padded, np.int64)
+    pos = 0
+    for r in range(dp):
+        for goff, n in bounds:
+            nb = n // dp
+            idx[pos:pos + nb] = np.arange(goff + r * nb,
+                                          goff + (r + 1) * nb)
+            pos += nb
+    return idx
+
+
+def to_natural(col: np.ndarray, total: int, dp: int,
+               bucket_bytes) -> np.ndarray:
+    """One (pipe, tensor) column of the dp-sharded global vector back to
+    natural leaf order, padding dropped — the inverse permutation."""
+    col = np.asarray(col)
+    padded, _ = flat_grid(total, dp, bucket_bytes)
+    if col.shape != (padded,):
+        raise ValueError(
+            f"column has shape {col.shape}, expected ({padded},) for "
+            f"total={total} sharded dp={dp}")
+    nat = np.empty_like(col)
+    nat[shard_permutation(total, dp, bucket_bytes)] = col
+    return nat[:total]
+
+
+def from_natural(nat: np.ndarray, dp: int, bucket_bytes) -> np.ndarray:
+    """Natural leaf-order vector (length ``total``) to the dp-sharded
+    bucket-major global order, zero-padded to the new grid."""
+    nat = np.asarray(nat)
+    total = nat.shape[0]
+    padded, _ = flat_grid(total, dp, bucket_bytes)
+    if padded != total:
+        nat = np.concatenate([nat, np.zeros(padded - total, nat.dtype)])
+    return nat[shard_permutation(total, dp, bucket_bytes)]
+
+
+_SAME = object()  # "same grid on both sides" default sentinel
+
+
+def reshard_flat(arr: np.ndarray, *, total: int, dp_old: int, dp_new: int,
+                 bucket_bytes, bucket_bytes_new=_SAME, pp: int = 1,
+                 tp: int = 1) -> np.ndarray:
+    """Re-partition a ``P(("pipe","data","tensor"))``-order global flat
+    vector from a ``dp_old`` grid to a ``dp_new`` grid (shrink or grow;
+    ``bucket_bytes_new`` additionally re-buckets — the natural-order
+    round trip makes a bucket-grid change free here, where the live
+    ``bucket_stamp`` guard must refuse it). Element-identical on the
+    natural content: ``to_natural(reshard_flat(x)) == to_natural(x)``
+    for every column, exactly — the padding tail is the only part
+    rebuilt (zeros).
+    """
+    if bucket_bytes_new is _SAME:
+        bucket_bytes_new = bucket_bytes
+    arr = np.asarray(arr)
+    padded_old, _ = flat_grid(total, dp_old, bucket_bytes)
+    padded_new, _ = flat_grid(total, dp_new, bucket_bytes_new)
+    if arr.shape != (pp * dp_old * tp * (padded_old // dp_old),):
+        raise ValueError(
+            f"flat array has shape {arr.shape}, expected "
+            f"({pp * tp * padded_old},) for total={total} over "
+            f"pp={pp} x dp={dp_old} x tp={tp}")
+    # (pp, dp, tp, chunk) mesh order -> (pp, tp) columns of (padded,)
+    cols = arr.reshape(pp, dp_old, tp, padded_old // dp_old) \
+              .transpose(0, 2, 1, 3).reshape(pp * tp, padded_old)
+    # the permutations depend only on (total, dp, bucket_bytes) — build
+    # each ONCE, not once per (pp*tp) column (at real model scale the
+    # O(padded) index builds dominate the one-shot restore otherwise)
+    idx_old = shard_permutation(total, dp_old, bucket_bytes)
+    idx_new = shard_permutation(total, dp_new, bucket_bytes_new)
+
+    def recolumn(col):
+        nat = np.empty_like(col)
+        nat[idx_old] = col                      # inverse of the old grid
+        if padded_new > total:
+            nat = np.concatenate(
+                [nat[:total], np.zeros(padded_new - total, nat.dtype)])
+        else:
+            nat = nat[:padded_new]
+        return nat[idx_new]                     # forward onto the new
+
+    new_cols = np.stack([recolumn(c) for c in cols])
+    return new_cols.reshape(pp, tp, dp_new, padded_new // dp_new) \
+                   .transpose(0, 2, 1, 3).reshape(-1)
+
+
+def reshard_zero_state(opt_state: Any, *, total: int, dp_old: int,
+                       dp_new: int, bucket_bytes,
+                       bucket_bytes_new=_SAME, pp: int = 1,
+                       tp: int = 1) -> Any:
+    """Reshard every flat-shard leaf of a
+    :class:`~apex_tpu.optimizers.distributed_fused.ZeroAdamState`
+    (``master``/``exp_avg``/``exp_avg_sq``) from ``dp_old`` to
+    ``dp_new``; ``step`` and ``bucket_stamp`` pass through (the bucket
+    grid itself is unchanged — the stamp stays valid on the new world and
+    the ``check_state`` guard at the jit boundary re-validates it
+    there). Leaves come back as numpy; the caller device_puts them onto
+    the new mesh's shard spec."""
+    kw = dict(total=total, dp_old=dp_old, dp_new=dp_new,
+              bucket_bytes=bucket_bytes, bucket_bytes_new=bucket_bytes_new,
+              pp=pp, tp=tp)
+    return opt_state._replace(
+        master=reshard_flat(np.asarray(opt_state.master), **kw),
+        exp_avg=reshard_flat(np.asarray(opt_state.exp_avg), **kw),
+        exp_avg_sq=reshard_flat(np.asarray(opt_state.exp_avg_sq), **kw))

@@ -18,10 +18,21 @@ fn(*state, *batch) -> (loss, *state)``; the data protocol is
 ``next(data) -> batch tuple`` plus ``state_dict()/load_state_dict()``
 (see :mod:`apex_tpu.elastic.data`).
 
-Exit discipline: the ONLY process exit in this package is
-``AutoResume.request_resume`` (enforced statically by
-``scripts/check_elastic_exits.py``) — every other failure propagates as
-an exception the scheduler can distinguish from a clean preemption.
+Exit discipline: process exits in this package are pinned to two blessed
+chokepoints — ``AutoResume.request_resume`` (this runner's preemption
+path) and ``launch.py::_supervisor_exit`` (the supervisor CLI's
+exit-code propagation) — enforced statically by the
+``ast-elastic-exits`` analysis rule (``scripts/check_elastic_exits.py``
+shim); every other failure propagates as an exception the scheduler can
+distinguish from a clean preemption.
+
+Multi-controller worlds (``jax.process_count() > 1``): the checkpointer
+switches to synchronous collective saves, and the per-step termination
+poll is OR-reduced across processes (:func:`apex_tpu.parallel.multiproc
+.any_process`) so every rank takes the drain path at the same step.
+Cross-WORLD-SIZE restarts (elastic shrink/grow) reshard the ZeRO flat
+shards through :mod:`apex_tpu.elastic.reshard` — see
+``docs/ROBUSTNESS.md`` "Multi-host".
 
 Metrics (host registry): ``resume/restore_ms``, ``resume/restored_step``
 (gauges), ``resume/resumes``, ``resume/preempt_exits`` (counters), plus
@@ -31,7 +42,10 @@ the ``ckpt/*`` family from :class:`~apex_tpu.elastic.ckpt
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import signal as _signal
+import threading
 import time
 from typing import Any, Callable, Optional
 
@@ -43,7 +57,48 @@ from apex_tpu.elastic.faults import FaultPlan
 from apex_tpu.observability.registry import MetricsRegistry, get_registry
 from apex_tpu.utils.autoresume import AutoResume
 
-__all__ = ["ElasticRunner", "FitResult"]
+__all__ = ["DrainInterrupt", "ElasticRunner", "FitResult"]
+
+
+class DrainInterrupt(KeyboardInterrupt):
+    """A second SIGTERM/SIGINT arrived while the preemption drain was
+    writing the final checkpoint. Raised from the signal handler so the
+    drain aborts immediately — a stuck save must not make the job
+    unkillable. Subclasses :class:`KeyboardInterrupt` on purpose: no
+    ``except Exception`` on the unwind path can swallow it. The
+    checkpoint being abandoned is at worst TORN (COMMITTED is written
+    last), so the previous COMMITTED generation stays the restore
+    point."""
+
+
+@contextlib.contextmanager
+def _second_signal_escalation():
+    """Two-signal semantics for the drain window: the FIRST
+    SIGTERM/SIGINT asked for the graceful drain that is now running; a
+    SECOND one during it raises :class:`DrainInterrupt` instead of
+    latching. Installed only around the drain (and only on the main
+    thread — signal handlers cannot be installed elsewhere, and only the
+    main thread receives them); the previous handlers are restored on
+    exit either way."""
+    if threading.current_thread() is not threading.main_thread():
+        yield
+        return
+
+    def escalate(signum, frame):
+        raise DrainInterrupt(
+            f"second termination signal "
+            f"({_signal.Signals(signum).name}) during the preemption "
+            f"drain — aborting the in-flight save so the job stays "
+            f"killable; the previous COMMITTED checkpoint remains the "
+            f"restore point")
+
+    prev = {s: _signal.signal(s, escalate)
+            for s in (_signal.SIGTERM, _signal.SIGINT)}
+    try:
+        yield
+    finally:
+        for s, h in prev.items():
+            _signal.signal(s, h)
 
 
 @dataclasses.dataclass
@@ -55,6 +110,7 @@ class FitResult:
     loss: Optional[float]         # last step's loss (None if no step ran)
     preempted: bool               # True: stopped on a termination request
     restored_from: Optional[int]  # checkpoint step this run resumed from
+    resharded: bool = False       # restore crossed a world-size change
 
 
 class ElasticRunner:
@@ -92,24 +148,172 @@ class ElasticRunner:
         self.on_step = on_step
         self._registry = (registry if registry is not None
                           else get_registry())
+        # multi-controller worlds checkpoint collectively+synchronously
+        # (device_get cannot snapshot shards other processes own); the
+        # async off-thread split stays the single-controller default
+        try:
+            self._multiprocess = jax.process_count() > 1
+        except Exception:
+            self._multiprocess = False
         self.ckpt = checkpointer if checkpointer is not None else \
             AsyncCheckpointer(
                 directory, fp32_on_disk=fp32_on_disk, keep_last=keep_last,
-                registry=self._registry,
+                registry=self._registry, collective=self._multiprocess,
                 fault_hook=(fault_plan.on_save_attempt if fault_plan
                             else None),
                 after_save=(fault_plan.after_save if fault_plan else None))
 
     # -- sidecar ----------------------------------------------------------
+    def _world_meta(self) -> Optional[dict]:
+        """The world geometry this trainer's checkpoints are laid out
+        for — rides in the host sidecar so a restart into a DIFFERENT
+        world (elastic shrink/grow) can detect the mismatch and take the
+        reshard path instead of a silent mis-restore. ``None`` for
+        trainers without a mesh (the layout is then world-independent)."""
+        mesh = getattr(self.trainer, "mesh", None)
+        if mesh is None:
+            return None
+        shape = dict(mesh.shape)
+        from apex_tpu.parallel.multiproc import process_count
+        meta = {"dp": int(shape.get("data", 1)),
+                "pp": int(shape.get("pipe", 1)),
+                "tp": int(shape.get("tensor", 1)),
+                "cp": int(shape.get("context", 1)),
+                "num_hosts": int(process_count())}
+        if getattr(self.trainer, "is_zero", False):
+            lay = getattr(getattr(self.trainer, "opt", None), "_layout",
+                          None)
+            if lay is not None:
+                meta["flat_total"] = int(lay.total)
+                meta["bucket_bytes"] = int(
+                    getattr(self.trainer.opt, "bucket_bytes", None) or 0)
+        return meta
+
     def _host_state(self, step: int) -> dict:
         host = {"step": int(step)}
         if self.data is not None and hasattr(self.data, "state_dict"):
             host["data"] = self.data.state_dict()
+        world = self._world_meta()
+        if world is not None:
+            host["world"] = world
         return host
+
+    # -- restore ----------------------------------------------------------
+    def _load_data_cursor(self, host: dict) -> None:
+        """Seek the data iterator to the sidecar cursor. A cursor saved
+        under a different host grid goes through the explicit ``reseek``
+        path (world-size change: the GLOBAL sequence is preserved, the
+        per-host slicing follows the new grid); same-grid restores keep
+        the strict ``load_state_dict`` validation."""
+        if (self.data is None or "data" not in host
+                or not hasattr(self.data, "load_state_dict")):
+            return
+        dstate = host["data"]
+        saved_hosts = (dstate.get("num_hosts")
+                       if isinstance(dstate, dict) else None)
+        cur_hosts = getattr(self.data, "num_hosts", None)
+        if (saved_hosts is not None and cur_hosts is not None
+                and int(saved_hosts) != int(cur_hosts)
+                and hasattr(self.data, "reseek")):
+            self.data.reseek(dstate)
+        else:
+            self.data.load_state_dict(dstate)
+
+    def _restore_resharded(self, state: tuple, saved: dict,
+                           cur: dict) -> tuple:
+        """Cross-world-size restore: the checkpoint's ZeRO flat shards
+        were laid out for ``saved['dp']``; re-partition them for
+        ``cur['dp']`` (docs/ROBUSTNESS.md, "Elastic world-size
+        shrink-resume"). Only the data axis may change — tp/pp/cp
+        resharding would need the partition-rule engine (ROADMAP item 1)
+        and is refused loudly above. Returns ``(restored, host)``."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from apex_tpu.elastic import reshard as _reshard
+
+        opt_state = state[2]
+        if not (hasattr(opt_state, "master")
+                and hasattr(opt_state, "bucket_stamp")):
+            raise ValueError(
+                f"world size changed (saved dp={saved['dp']}, live "
+                f"dp={cur['dp']}) but the optimizer state "
+                f"({type(opt_state).__name__}) is not a ZeRO flat-shard "
+                f"state this runner knows how to reshard")
+        if "flat_total" not in saved:
+            raise ValueError(
+                f"world size changed (saved dp={saved['dp']}, live "
+                f"dp={cur['dp']}) but the checkpoint sidecar carries no "
+                f"flat_total — it was not written by a ZeRO trainer, so "
+                f"there is no flat-shard layout to reshard")
+        total = int(saved["flat_total"])
+        bb_old = int(saved.get("bucket_bytes", 0)) or None
+        bb_new = int(getattr(self.trainer.opt, "bucket_bytes", None)
+                     or 0) or None
+        pp, tp = int(saved["pp"]), int(saved["tp"])
+        dp_old, dp_new = int(saved["dp"]), int(cur["dp"])
+        padded_old, _ = _reshard.flat_grid(total, dp_old, bb_old)
+        # restore the old-layout flat vectors REPLICATED on the live
+        # mesh: a target without a sharding makes orbax fall back to the
+        # sharding stored in the checkpoint, which names the DEAD
+        # world's devices. (Replicated = every surviving host reads the
+        # full flat vector — fine at the optimizer-state scale this
+        # serves; a shard-aware read is an optimization for later.)
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = getattr(self.trainer, "mesh", None)
+        flat_sds = jax.ShapeDtypeStruct(
+            (pp * tp * padded_old,), jnp.float32,
+            sharding=(NamedSharding(mesh, PartitionSpec())
+                      if mesh is not None else None))
+        old_target = (*state[:2],
+                      opt_state._replace(master=flat_sds,
+                                         exp_avg=flat_sds,
+                                         exp_avg_sq=flat_sds),
+                      *state[3:])
+
+        # leaves WITHOUT a mesh sharding (loss-scale scalars live on a
+        # single default device) normally restore via orbax's
+        # sharding-from-file fallback — but this checkpoint's file
+        # shardings name the DEAD world's devices, so pin every such
+        # leaf to replicated on the live mesh instead
+        def pin(x):
+            if _ckpt._is_prng_key(x):
+                return x  # key leaves keep the default path
+            sh = getattr(x, "sharding", None)
+            if (sh is not None and not hasattr(sh, "mesh")
+                    and mesh is not None):
+                return jax.ShapeDtypeStruct(
+                    x.shape, x.dtype,
+                    sharding=NamedSharding(mesh, PartitionSpec()))
+            return x
+
+        old_target = jax.tree_util.tree_map(
+            pin, old_target, is_leaf=_ckpt._is_prng_key)
+        restored, host = _ckpt.restore_checkpoint(self.directory,
+                                                  old_target)
+        ropt = _reshard.reshard_zero_state(
+            restored[2], total=total, dp_old=dp_old, dp_new=dp_new,
+            bucket_bytes=bb_old, bucket_bytes_new=bb_new, pp=pp, tp=tp)
+        # land each resharded flat leaf on the LIVE state's sharding (the
+        # new mesh's shard spec, straight off the init_state target)
+        put = lambda np_leaf, live: jax.device_put(
+            np.asarray(np_leaf), live.sharding)
+        new_opt = restored[2]._replace(
+            master=put(ropt.master, opt_state.master),
+            exp_avg=put(ropt.exp_avg, opt_state.exp_avg),
+            exp_avg_sq=put(ropt.exp_avg_sq, opt_state.exp_avg_sq))
+        if (bb_old or 0) != (bb_new or 0):
+            # the reshard re-bucketed the shards for the NEW grid, so
+            # the stamp must now certify the new layout — check_state at
+            # the jit boundary re-validates it against the live config
+            new_opt = new_opt._replace(
+                bucket_stamp=jnp.asarray(bb_new or 0, jnp.int32))
+        self._registry.counter("resume/reshards").inc()
+        return (*restored[:2], new_opt, *restored[3:]), host
 
     def _restore(self, state: tuple) -> tuple:
         """Latest-COMMITTED restore onto the live state's layout; returns
-        ``(state, completed_steps, restored_from)``."""
+        ``(state, completed_steps, restored_from, resharded)``."""
         latest = _ckpt.latest_step(self.directory)
         if latest is None:
             # still warn about torn dirs a dead writer left behind
@@ -120,46 +324,87 @@ class ElasticRunner:
                     f"no committed checkpoint under {self.directory!r}; "
                     f"ignoring torn dir(s) at step(s) {torn} and starting "
                     "from scratch")
-            return state, 0, None
+            return state, 0, None, False
         t0 = time.perf_counter()
-        restored, host = _ckpt.restore_checkpoint(self.directory, state)
+        # peek at the saved world BEFORE building the restore target:
+        # the ZeRO flat-shard shapes on disk are a function of the OLD dp
+        _, peek = _ckpt.read_host_state(self.directory, latest)
+        saved_world, cur_world = peek.get("world"), self._world_meta()
+        resharded = False
+        if (saved_world is not None and cur_world is not None
+                and any(int(saved_world.get(k, cur_world[k]))
+                        != cur_world[k] for k in ("pp", "tp", "cp"))):
+            raise ValueError(
+                f"checkpoint was saved on a pp={saved_world.get('pp')} x "
+                f"tp={saved_world.get('tp')} x "
+                f"cp={saved_world.get('cp')} grid but this trainer runs "
+                f"pp={cur_world['pp']} x tp={cur_world['tp']} x "
+                f"cp={cur_world['cp']}; only the data axis is elastic — "
+                f"model-axis resharding needs the partition-rule engine")
+        if (saved_world is not None and cur_world is not None
+                and int(saved_world.get("dp", cur_world["dp"]))
+                != cur_world["dp"]
+                and getattr(self.trainer, "is_zero", False)):
+            restored, host = self._restore_resharded(
+                state, saved_world, cur_world)
+            resharded = True
+        else:
+            # replicated/param leaves have dp-independent global shapes,
+            # so a dp change without ZeRO state restores verbatim
+            restored, host = _ckpt.restore_checkpoint(self.directory,
+                                                      state)
         self._registry.gauge("resume/restore_ms").set(
             (time.perf_counter() - t0) * 1e3)
         step = int(host.get("step", latest))
         self._registry.gauge("resume/restored_step").set(step)
         self._registry.counter("resume/resumes").inc()
-        if (self.data is not None and "data" in host
-                and hasattr(self.data, "load_state_dict")):
-            self.data.load_state_dict(host["data"])
+        self._load_data_cursor(host)
         # the restored step IS durably on disk — mark it saved, so a fit
         # that runs zero further steps (restart after completion, or a
         # preemption landing immediately) does not re-save it:
         # save_checkpoint rmtree's the existing dir before rewriting, and
         # a kill in that window would destroy the newest (with
-        # keep_last=1, the only) COMMITTED checkpoint
-        self.ckpt.last_saved_step = step
+        # keep_last=1, the only) COMMITTED checkpoint. EXCEPTION: a
+        # resharded restore must re-save promptly — the on-disk layout
+        # still belongs to the OLD world and a second restart would pay
+        # the reshard again (and the old-world sidecar would keep
+        # winning), so leave last_saved_step unset to let the cadence
+        # write a new-world generation.
+        if not resharded:
+            self.ckpt.last_saved_step = step
         # materialize XLA-owned buffers before the state can be DONATED:
         # orbax-restored arrays may alias host memory the runtime does not
         # own, and jit_train_step's donate_argnums would free/reuse it
         # under the allocator's feet (see elastic/ckpt.owned_copy)
-        return tuple(owned_copy(restored)), step, step
+        return tuple(owned_copy(restored)), step, step, resharded
 
     # -- preemption -------------------------------------------------------
     def _preempt(self, ar: AutoResume, state: tuple, step: int,
-                 loss: Any, restored_from: Optional[int]) -> FitResult:
+                 loss: Any, restored_from: Optional[int],
+                 resharded: bool = False) -> FitResult:
         """The grace-window path: drain the in-flight save, write a final
         checkpoint at the current completed step, then hand control back
-        to the scheduler (exit 0 via ``request_resume``)."""
-        self.ckpt.drain()
-        if self.ckpt.last_saved_step != step:
-            self.ckpt.save(state, step, host_state=self._host_state(step),
-                           block=True)
+        to the scheduler (exit 0 via ``request_resume``).
+
+        Two-signal semantics: the drain runs under
+        :func:`_second_signal_escalation` — a SECOND SIGTERM/SIGINT while
+        the final save is being written raises :class:`DrainInterrupt`
+        immediately (a stuck/slow save cannot make the job unkillable;
+        the abandoned write is at worst a torn dir the next restore skips
+        loudly)."""
+        with _second_signal_escalation():
+            self.ckpt.drain()
+            if self.ckpt.last_saved_step != step:
+                self.ckpt.save(state, step,
+                               host_state=self._host_state(step),
+                               block=True)
         self._registry.counter("resume/preempt_exits").inc()
         if self.exit_on_preempt:
             ar.request_resume()  # sys.exit(0): scheduler restarts the job
         return FitResult(state=state, step=step,
                          loss=None if loss is None else float(loss),
-                         preempted=True, restored_from=restored_from)
+                         preempted=True, restored_from=restored_from,
+                         resharded=resharded)
 
     # -- the loop ---------------------------------------------------------
     def fit(self, steps: int, *, key: Optional[jax.Array] = None,
@@ -182,13 +427,17 @@ class ElasticRunner:
         if state is None:
             state = self.trainer.init_state(
                 key if key is not None else jax.random.PRNGKey(0))
-        state, step, restored_from = self._restore(tuple(state))
+        state, step, restored_from, resharded = self._restore(tuple(state))
         ar = self.autoresume
         own_ar = ar is None
         if own_ar:
             ar = AutoResume(interval=1)
         step_fn = self.trainer.jit_train_step()
         loss = None
+        if self._multiprocess:
+            from apex_tpu.parallel.multiproc import any_process
+        else:
+            any_process = bool
         if no_recompile:
             from apex_tpu.analysis.program import recompile_guard
             guard = recompile_guard("ElasticRunner.fit")
@@ -204,7 +453,14 @@ class ElasticRunner:
                 while step < steps:
                     if self.fault_plan is not None:
                         self.fault_plan.before_step(step)
-                    if ar.termination_requested(step):
+                    # multi-controller: the preemption decision must be
+                    # COLLECTIVE — if any process saw the signal, every
+                    # process must leave the loop at this same step, or
+                    # the survivors deadlock in the next step's
+                    # collectives while the drained rank waits in the
+                    # checkpoint barrier (any_process is a tiny
+                    # allgather; the identity in a 1-process world)
+                    if any_process(ar.termination_requested(step)):
                         preempted = True
                         break
                     batch = next(self.data)
@@ -236,18 +492,20 @@ class ElasticRunner:
                     saved_once = saved_once or saved
             if preempted:
                 return self._preempt(ar, state, step, loss,
-                                     restored_from)
+                                     restored_from, resharded)
             # run complete: drain the tail save, then commit the final one
             self.ckpt.drain()
-            if ar.termination_requested(step):
-                return self._preempt(ar, state, step, loss, restored_from)
+            if any_process(ar.termination_requested(step)):
+                return self._preempt(ar, state, step, loss, restored_from,
+                                     resharded)
             if self.final_save and self.ckpt.last_saved_step != step:
                 self.ckpt.save(state, step,
                                host_state=self._host_state(step),
                                block=True)
             return FitResult(state=state, step=step,
                              loss=None if loss is None else float(loss),
-                             preempted=False, restored_from=restored_from)
+                             preempted=False, restored_from=restored_from,
+                             resharded=resharded)
         finally:
             if own_ar:
                 ar.close()

@@ -1,32 +1,215 @@
-"""Deprecated stub (SURVEY §7.7): the pre-torchrun process launcher.
+"""Multi-process (multi-host) runtime bootstrap — the real launcher.
 
-The reference (``reference:apex/parallel/multiproc.py:5-35``) spawns
-``world_size`` local processes with ``--rank i`` args — a pre-``torchrun``
-convenience that NVIDIA itself deprecated.
+The reference's ``multiproc.py`` (``reference:apex/parallel/multiproc.py:
+5-35``) spawns ``world_size`` local processes with ``--rank i`` args — the
+pre-``torchrun`` convenience launcher. This module is its TPU-shaped
+graduation from documented stub to real implementation (ROADMAP item 3):
+the **worker half** of multi-host bootstrap. One Python process per host
+drives all of that host's devices; processes rendezvous through
+``jax.distributed.initialize(coordinator_address, num_processes,
+process_id)``, after which ``jax.devices()`` is the *global* device list
+and one SPMD program spans every host. The **supervisor half** (process
+spawning, heartbeats, restart/shrink policy) lives in
+:mod:`apex_tpu.elastic.launch`; this module owns the per-process
+environment protocol the two halves speak:
 
-On TPU the launcher role is subsumed by SPMD: one Python process per host
-drives all local devices, and multi-host initialization is
-``jax.distributed.initialize()`` (automatic on Cloud TPU). Parallelism is
-expressed in the program (``jax.sharding.Mesh`` +
-``apex_tpu.transformer.parallel_state``), not by spawning ranked
-processes. Running this module prints that guidance and exits non-zero.
+======================  =====================================================
+env var                 meaning
+======================  =====================================================
+APEX_TPU_COORDINATOR    ``host:port`` of the rendezvous coordinator
+                        (process 0 starts the service on it)
+APEX_TPU_NUM_PROCESSES  world size (process count)
+APEX_TPU_PROCESS_ID     this process's rank in ``[0, num_processes)``
+APEX_TPU_LOCAL_DEVICES  virtual CPU devices to force per process (localhost
+                        simulation; unset/0 = use the real local devices)
+APEX_TPU_RUN_DIR        scratch dir shared with the supervisor (heartbeats)
+======================  =====================================================
+
+On CPU the cross-process collectives run over the **gloo** transport
+(``jax_cpu_collectives_implementation``) — a localhost 2-process x
+4-virtual-device mesh exercises the exact multi-controller code paths
+(global meshes, collective checkpointing, cross-host psums) a TPU pod
+runs, with DCN replaced by loopback TCP. On real Cloud TPU slices the
+coordinator/rank values come from the platform and
+``jax.distributed.initialize()`` discovers them; the env protocol here is
+only needed when a supervisor owns placement.
+
+Order matters: :func:`initialize` (or :func:`initialize_from_env`) must
+run before *any* JAX backend use in the process — it forces the virtual
+device count and the collectives transport, both of which are sealed at
+backend initialization.
 """
 
-import sys
+from __future__ import annotations
 
-_MSG = (
-    "apex_tpu.parallel.multiproc is a documented stub: on TPU there is no "
-    "per-rank process launcher. One process per host drives all local "
-    "devices; call jax.distributed.initialize() for multi-host, and "
-    "express DP/TP/PP over a jax.sharding.Mesh "
-    "(apex_tpu.transformer.parallel_state.initialize_model_parallel)."
-)
+import dataclasses
+import os
+from typing import Dict, Optional
+
+__all__ = ["ProcessInfo", "initialize", "initialize_from_env",
+           "process_env", "process_id", "process_count", "any_process",
+           "main",
+           "ENV_COORDINATOR", "ENV_NUM_PROCESSES", "ENV_PROCESS_ID",
+           "ENV_LOCAL_DEVICES", "ENV_RUN_DIR"]
+
+ENV_COORDINATOR = "APEX_TPU_COORDINATOR"
+ENV_NUM_PROCESSES = "APEX_TPU_NUM_PROCESSES"
+ENV_PROCESS_ID = "APEX_TPU_PROCESS_ID"
+ENV_LOCAL_DEVICES = "APEX_TPU_LOCAL_DEVICES"
+ENV_RUN_DIR = "APEX_TPU_RUN_DIR"
+
+_INFO: Optional["ProcessInfo"] = None
 
 
-def main() -> int:
-    print(_MSG, file=sys.stderr)
-    return 1
+@dataclasses.dataclass(frozen=True)
+class ProcessInfo:
+    """What :func:`initialize` established for this process."""
+
+    process_id: int
+    num_processes: int
+    coordinator_address: Optional[str]
+    local_devices: Optional[int]
+    run_dir: Optional[str]
+
+
+def process_env(process_id: int, num_processes: int,
+                coordinator_address: str, *,
+                local_devices: Optional[int] = None,
+                run_dir: Optional[str] = None) -> Dict[str, str]:
+    """The env-var block a supervisor hands worker ``process_id`` — the
+    other half of :func:`initialize_from_env`."""
+    if not 0 <= process_id < num_processes:
+        raise ValueError(f"bad rank {process_id}/{num_processes}")
+    env = {ENV_COORDINATOR: str(coordinator_address),
+           ENV_NUM_PROCESSES: str(int(num_processes)),
+           ENV_PROCESS_ID: str(int(process_id))}
+    if local_devices:
+        env[ENV_LOCAL_DEVICES] = str(int(local_devices))
+    if run_dir:
+        env[ENV_RUN_DIR] = str(run_dir)
+    return env
+
+
+def initialize(coordinator_address: Optional[str], num_processes: int,
+               process_id: int, *, local_devices: Optional[int] = None,
+               run_dir: Optional[str] = None) -> ProcessInfo:
+    """Bootstrap this process into an ``num_processes``-wide world.
+
+    Must run before any JAX backend use. Steps, in the only order that
+    works:
+
+    1. ``local_devices`` set → force that many virtual CPU devices
+       (:func:`~apex_tpu.utils.hostmesh.force_virtual_cpu_devices` —
+       XLA_FLAGS must be written before the backend exists);
+    2. select the ``gloo`` CPU collectives transport (cross-process CPU
+       collectives are disabled by default; sealed at backend init);
+    3. ``jax.distributed.initialize(...)`` — skipped at
+       ``num_processes == 1`` (a single-process world needs no
+       coordinator; ``jax.process_count()`` is already 1).
+
+    Returns (and caches) a :class:`ProcessInfo`; :func:`process_id` /
+    :func:`process_count` read the cache without touching the backend.
+    """
+    global _INFO
+    if not 0 <= process_id < num_processes:
+        raise ValueError(f"bad rank {process_id}/{num_processes}")
+    if num_processes > 1 and not coordinator_address:
+        raise ValueError(
+            "a multi-process world needs a coordinator_address "
+            "(host:port; process 0 starts the service on it)")
+    if local_devices:
+        from apex_tpu.utils.hostmesh import force_virtual_cpu_devices
+        # verify=False: the count check initializes the backend, and
+        # jax.distributed.initialize refuses to run after that
+        force_virtual_cpu_devices(int(local_devices), verify=False)
+    import jax
+    if num_processes > 1:
+        try:
+            # cross-process CPU collectives ride the gloo transport; the
+            # flag does not exist on every jax line — leave those to the
+            # backend default rather than failing the bootstrap
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=int(num_processes),
+            process_id=int(process_id))
+    if local_devices and jax.local_device_count() < int(local_devices):
+        raise RuntimeError(
+            f"asked for {local_devices} local virtual CPU devices but "
+            f"the backend initialized with {jax.local_device_count()} — "
+            f"the JAX backend was touched before initialize()")
+    _INFO = ProcessInfo(process_id=int(process_id),
+                        num_processes=int(num_processes),
+                        coordinator_address=coordinator_address,
+                        local_devices=(int(local_devices)
+                                       if local_devices else None),
+                        run_dir=run_dir)
+    return _INFO
+
+
+def initialize_from_env() -> Optional[ProcessInfo]:
+    """Worker-side bootstrap from the supervisor's env block. Returns
+    ``None`` (and does nothing) when ``APEX_TPU_COORDINATOR`` is unset —
+    safe to call unconditionally at the top of a training script."""
+    coord = os.environ.get(ENV_COORDINATOR)
+    if not coord:
+        return None
+    return initialize(
+        coord,
+        int(os.environ.get(ENV_NUM_PROCESSES, "1")),
+        int(os.environ.get(ENV_PROCESS_ID, "0")),
+        local_devices=int(os.environ.get(ENV_LOCAL_DEVICES, "0")) or None,
+        run_dir=os.environ.get(ENV_RUN_DIR) or None)
+
+
+def process_id() -> int:
+    """This process's rank: the :func:`initialize` cache, else the env
+    protocol, else 0. Never touches the JAX backend (callable from fault
+    hooks before jax is imported)."""
+    if _INFO is not None:
+        return _INFO.process_id
+    return int(os.environ.get(ENV_PROCESS_ID, "0"))
+
+
+def process_count() -> int:
+    """World size, same resolution order as :func:`process_id`."""
+    if _INFO is not None:
+        return _INFO.num_processes
+    return int(os.environ.get(ENV_NUM_PROCESSES, "1"))
+
+
+def any_process(flag: bool) -> bool:
+    """Cross-process OR of a host-side bool — the collective decision
+    primitive the elastic runner's termination poll uses: if ANY process
+    saw the preemption signal, every process must take the drain path at
+    the SAME step, or the survivors deadlock in the next step's
+    collectives while the drained rank waits in the checkpoint barrier.
+    Free (no collective) in a single-process world."""
+    import jax
+    if jax.process_count() == 1:
+        return bool(flag)
+    import numpy as np
+    from jax.experimental import multihost_utils
+    got = multihost_utils.process_allgather(
+        np.asarray(bool(flag), np.bool_))
+    return bool(np.any(got))
+
+
+def main(argv=None) -> int:
+    """CLI launcher: ``python -m apex_tpu.parallel.multiproc -n 2 --
+    worker.py args...`` — the reference module's launcher role, now a
+    strict alias of the elastic supervisor CLI
+    (:func:`apex_tpu.elastic.launch.main`: heartbeats, bounded
+    restart-with-backoff, world-size shrink; one argparse surface, so
+    the two advertised entry points cannot drift)."""
+    from apex_tpu.elastic.launch import main as _launch_main
+
+    return _launch_main(argv)
 
 
 if __name__ == "__main__":
+    import sys
+
     sys.exit(main())

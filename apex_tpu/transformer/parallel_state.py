@@ -64,6 +64,66 @@ _VIRTUAL_PP_RANK: Optional[int] = None
 _PP_SPLIT_RANK: Optional[int] = None
 
 
+def _dcn_device_grid(devices: Sequence, tp: int, pp: int, cp: int,
+                     dp: int) -> np.ndarray:
+    """dp-outermost-over-DCN device grid for a multi-process world.
+
+    **The axis-ordering rule** (ROADMAP item 3, documented in
+    docs/ROBUSTNESS.md): in a multi-process (multi-host) world, the
+    inter-process links (DCN / loopback on the localhost simulation) are
+    orders of magnitude slower than intra-process ICI, so the mesh must
+    place the axes whose collectives are *latency-tolerant and
+    overlappable* across the slow links and keep the *latency-critical*
+    axes inside a process:
+
+    - **data** spans processes: its grad reduce-scatter/all-gather is
+      once per step and rides under the backward (PR 8's interleaved
+      buckets exist to hide exactly this transfer);
+    - **tensor / context / pipeline** stay intra-process: tp collectives
+      sit on the critical path of every layer (activation
+      gather/scatter), and the pipe ppermute latency bounds the bubble.
+
+    Grid construction: group the devices by ``process_index`` (equal
+    local counts required), factor ``dp = num_processes x dp_local``, lay
+    each process's devices out ``(dp_local, pp, cp, tp)`` locally (tp
+    fastest, matching the single-process convention), and make the
+    process index the OUTERMOST factor of the data axis — so a
+    data-axis collective crosses the DCN exactly once per ring step, and
+    no tp/pp/cp neighbor pair ever spans a process boundary.
+    """
+    by_proc: dict = {}
+    for d in devices:
+        by_proc.setdefault(getattr(d, "process_index", 0), []).append(d)
+    procs = sorted(by_proc)
+    nproc = len(procs)
+    counts = {len(by_proc[p]) for p in procs}
+    if len(counts) != 1:
+        raise RuntimeError(
+            f"uneven per-process device counts "
+            f"{ {p: len(by_proc[p]) for p in procs} } — the DCN layout "
+            f"needs identical local topology on every process")
+    per = counts.pop()
+    if dp % nproc != 0:
+        raise RuntimeError(
+            f"data-parallel size {dp} is not divisible by the process "
+            f"count {nproc}: dp is the axis that spans the DCN, so every "
+            f"process must hold the same number of dp ranks")
+    dp_local = dp // nproc
+    if per != dp_local * pp * cp * tp:
+        raise RuntimeError(
+            f"per-process device count {per} != dp_local({dp_local}) x "
+            f"pp({pp}) x cp({cp}) x tp({tp}) — tensor/pipeline/context "
+            f"axes must fit inside one process (only dp spans the DCN)")
+    local = [sorted(by_proc[p], key=lambda d: getattr(d, "id", 0))
+             for p in procs]
+    natural = np.empty((nproc, per), dtype=object)
+    for i, devs in enumerate(local):
+        natural[i, :] = devs
+    natural = natural.reshape(nproc, dp_local, pp, cp, tp)
+    # (proc, dp_local, pp, cp, tp) -> (pp, proc x dp_local = dp, cp, tp)
+    return natural.transpose(2, 0, 1, 3, 4).reshape(pp, dp, cp, tp)
+
+
 def initialize_model_parallel(
     tensor_model_parallel_size: int = 1,
     pipeline_model_parallel_size: int = 1,
@@ -71,6 +131,7 @@ def initialize_model_parallel(
     pipeline_model_parallel_split_rank: Optional[int] = None,
     context_parallel_size: int = 1,
     devices: Optional[Sequence] = None,
+    dcn_data_parallel: Optional[bool] = None,
 ) -> Mesh:
     """Build and install the global mesh (``parallel_state.py:73-247``).
 
@@ -81,6 +142,14 @@ def initialize_model_parallel(
     context_parallel`) out of the data dimension — the reference has no CP
     groups at all (SURVEY §2.3); the layout follows Megatron-LM's later
     convention: tp fastest, then cp, then dp, then pp.
+
+    ``dcn_data_parallel`` selects the multi-host layout rule
+    (:func:`_dcn_device_grid`): the data axis is laid out outermost over
+    the process (DCN) dimension while tp/pp/cp stay strictly
+    intra-process. Default ``None`` auto-enables it exactly when the
+    device set spans more than one process — a single-process mesh keeps
+    the legacy ``(pp, dp, cp, tp)`` reshape bit-for-bit (every existing
+    single-host checkpoint/test layout is unchanged).
     """
     global _MESH, _VIRTUAL_PP_SIZE, _VIRTUAL_PP_RANK, _PP_SPLIT_RANK
     if devices is None:
@@ -97,8 +166,14 @@ def initialize_model_parallel(
         raise RuntimeError(
             "pipeline-model-parallel size must be at least 2 with the "
             "interleaved schedule")
-    # rank layout: tp fastest, then cp, then dp, then pp
-    grid = np.asarray(devices).reshape(pp, dp, cp, tp)
+    if dcn_data_parallel is None:
+        dcn_data_parallel = len(
+            {getattr(d, "process_index", 0) for d in devices}) > 1
+    if dcn_data_parallel:
+        grid = _dcn_device_grid(devices, tp, pp, cp, dp)
+    else:
+        # single-host rank layout: tp fastest, then cp, then dp, then pp
+        grid = np.asarray(devices).reshape(pp, dp, cp, tp)
     _MESH = Mesh(grid, (PIPE_AXIS, DATA_AXIS, CONTEXT_AXIS, TENSOR_AXIS))
     _VIRTUAL_PP_SIZE = virtual_pipeline_model_parallel_size
     _VIRTUAL_PP_RANK = 0 if virtual_pipeline_model_parallel_size else None

@@ -26,12 +26,19 @@ import re
 _FLAG = "--xla_force_host_platform_device_count"
 
 
-def force_virtual_cpu_devices(n_devices: int) -> None:
+def force_virtual_cpu_devices(n_devices: int, verify: bool = True) -> None:
     """Force the CPU platform with ``n_devices`` virtual devices.
 
     Must run before the JAX backend is first used (importing jax is fine;
     calling ``jax.devices()`` etc. is not).  Raises if a backend with fewer
     devices was already initialized.
+
+    ``verify=False`` skips the device-count check — which itself
+    INITIALIZES the backend. The multi-process bootstrap
+    (:func:`apex_tpu.parallel.multiproc.initialize`) needs that:
+    ``jax.distributed.initialize`` refuses to run after any backend use,
+    so it sets the flags unverified, rendezvouses, and only then counts
+    devices.
     """
     flags = os.environ.get("XLA_FLAGS", "")
     if _FLAG in flags:
@@ -43,7 +50,7 @@ def force_virtual_cpu_devices(n_devices: int) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    if jax.device_count() < n_devices:
+    if verify and jax.device_count() < n_devices:
         raise RuntimeError(
             f"needed {n_devices} virtual CPU devices but the "
             f"{jax.default_backend()} backend is already initialized with "

@@ -172,17 +172,20 @@ def _plant_metrics_doc(tmp_path):
            # the PR 12 call shapes: a bucketed latency histogram and an
            # slo/ gauge — the doc contract must see through both
            "    reg.histogram('serve/rogue_wait_ms', buckets).observe(x)\n"
-           "    reg.gauge('slo/rogue_goodput').set(x)\n")
+           "    reg.gauge('slo/rogue_goodput').set(x)\n"
+           # the PR 13 supervisor family: elastic/* is under the doc
+           # contract like every other elastic-runtime family
+           "    reg.gauge('elastic/rogue_world').set(x)\n")
     _write(tmp_path, "docs/OBSERVABILITY.md", "| nothing documented |\n")
 
 
 def _expect_metrics_doc(findings):
     undoc = [f for f in findings if f.kind == "UNDOC"]
-    assert len(undoc) == 7  # record x2 + gauge x2 + counter + hist x2
+    assert len(undoc) == 8  # record x2 + gauge x3 + counter + hist x2
     for name in ("health/rogue_metric", "health/<>/rogue_family",
                  "perf/rogue_attribution", "ckpt/rogue_bytes",
                  "serve/rogue_ms", "serve/rogue_wait_ms",
-                 "slo/rogue_goodput"):
+                 "slo/rogue_goodput", "elastic/rogue_world"):
         assert any(name in f.message for f in undoc), name
 
 
@@ -194,6 +197,7 @@ def _plant_metric_family(tmp_path):
            "    reg.gauge(f'memory/peak/device{i}').set(x)\n"  # exempt
            "    reg.gauge('serve/queue_depth').set(x)\n"       # known
            "    reg.gauge('slo/goodput').set(x)\n"             # known (PR 12)
+           "    reg.gauge('elastic/world_size').set(x)\n"      # known (PR 13)
            "    reg.gauge('no_slash_name').set(x)\n")          # unprefixed
     # even a documented row does not excuse an unregistered FAMILY
     _write(tmp_path, "docs/OBSERVABILITY.md", "| `newfam/widgets` |\n")
@@ -271,6 +275,50 @@ def _expect_elastic_choke_rot(findings):
     assert any(f.kind == "CHOKE" for f in findings)
 
 
+_LAUNCH_CHOKE = ("def _supervisor_exit(code):\n"
+                 "    import sys\n"
+                 "    sys.exit(int(code))\n")
+
+
+def _plant_launch_exit(tmp_path):
+    """launch.py may exit ONLY inside _supervisor_exit: a sys.exit in
+    any other supervisor function is the violation; the blessed one is
+    not."""
+    _elastic_chokepoint(tmp_path)
+    _write(tmp_path, "apex_tpu/elastic/launch.py",
+           "import sys\n"
+           + _LAUNCH_CHOKE +
+           "def run(report):\n"
+           "    sys.exit(0 if report else 1)\n")
+
+
+def _expect_launch_exit(findings):
+    flagged = [f for f in findings if f.kind == "EXIT"]
+    assert len(flagged) == 1
+    assert "launch.py:6" in flagged[0].where
+    assert "_supervisor_exit" in flagged[0].message
+    # the blessed chokepoint itself never fires, and its shape is fine
+    assert not any(f.kind == "CHOKE" for f in findings)
+
+
+def _plant_launch_choke_rot(tmp_path):
+    """Chokepoint rot: _supervisor_exit exists but no longer holds
+    exactly one sys.exit (here: two) — the anchor the rule pins must not
+    silently decay."""
+    _elastic_chokepoint(tmp_path)
+    _write(tmp_path, "apex_tpu/elastic/launch.py",
+           "import sys\n"
+           "def _supervisor_exit(code):\n"
+           "    sys.exit(int(code))\n"
+           "    sys.exit(1)\n")
+
+
+def _expect_launch_choke_rot(findings):
+    choke = [f for f in findings if f.kind == "CHOKE"
+             and "launch.py" in f.where]
+    assert len(choke) == 1 and "found 2" in choke[0].message
+
+
 def _plant_bench(tmp_path):
     _seed_bench_repo(
         tmp_path,
@@ -341,6 +389,10 @@ PLANTED = [
      _expect_elastic_exits),
     ("ast-elastic-exits/choke-rot", rule_elastic_exits,
      _plant_elastic_choke_rot, _expect_elastic_choke_rot),
+    ("ast-elastic-exits/launch", rule_elastic_exits, _plant_launch_exit,
+     _expect_launch_exit),
+    ("ast-elastic-exits/launch-choke-rot", rule_elastic_exits,
+     _plant_launch_choke_rot, _expect_launch_choke_rot),
     ("ast-bench-configs", rule_bench_configs, _plant_bench,
      _expect_bench),
 ]
@@ -372,7 +424,7 @@ def test_documenting_fixes_metrics_doc(tmp_path):
            "| `health/rogue_metric` | `health/<tree>/rogue_family` |\n"
            "| `perf/rogue_attribution` | `ckpt/rogue_bytes` |\n"
            "| `serve/rogue_ms` | `serve/rogue_wait_ms` |\n"
-           "| `slo/rogue_goodput` |\n")
+           "| `slo/rogue_goodput` | `elastic/rogue_world` |\n")
     findings, _ = rule_metrics_doc(str(tmp_path))
     assert not findings
 
@@ -550,6 +602,45 @@ class TestDonation:
                                   min_alias_bytes=a.nbytes + b.nbytes)
         findings = check_donation(compiled, expected_donated=3)
         assert any(f.kind == "UNALIASED" for f in findings)
+
+    def test_cache_deserialized_executable_trusts_the_alias_map(self):
+        """An executable deserialized from the PERSISTENT compilation
+        cache reports ``alias_size_in_bytes == 0`` while its HLO alias
+        map is intact (reproduced live: fresh compile 4096, cache hit 0,
+        identical map — this hard-failed the dryrun serving leg on every
+        warm-cache retry). With a COMPLETE map the floor must not fire;
+        a genuinely partial alias (0 < bytes < floor) still must."""
+
+        class FakeAnalysis:
+            def __init__(self, alias):
+                self.alias_size_in_bytes = alias
+
+        class FakeCompiled:
+            def __init__(self, alias):
+                self._alias = alias
+
+            def as_text(self):
+                return ("HloModule jit_step, "
+                        "input_output_alias={ {0}: (0, {}, "
+                        "may-alias), {1}: (1, {}, may-alias) }\n")
+
+            def memory_analysis(self):
+                return FakeAnalysis(self._alias)
+
+        # cache case: 0 bytes next to a complete 2-entry map -> silent
+        assert not check_donation(FakeCompiled(0), expected_donated=2,
+                                  min_alias_bytes=4096)
+        # partial alias: nonzero-but-small bytes -> still a finding
+        findings = check_donation(FakeCompiled(100), expected_donated=2,
+                                  min_alias_bytes=4096)
+        assert [f.kind for f in findings] == ["UNALIASED"]
+        assert "alias_size_in_bytes 100" in findings[0].message
+        # 0 bytes next to an INCOMPLETE map is still two findings
+        # (missing leaf + floor), not excused
+        findings = check_donation(FakeCompiled(0), expected_donated=3,
+                                  min_alias_bytes=4096)
+        assert sorted(f.kind for f in findings) == ["UNALIASED",
+                                                    "UNALIASED"]
 
 
 # ---------------------------------------------------------------------------

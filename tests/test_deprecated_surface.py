@@ -1,5 +1,7 @@
 """Deprecated-surface parity: fp16_utils works as a thin adapter; RNN/
-reparameterization/pyprof/multiproc are documented stubs (SURVEY §7.7)."""
+reparameterization are documented stubs (SURVEY §7.7). pyprof (PR 6) and
+multiproc (PR 13) graduated to real packages; their era-appropriate stub
+surfaces are pinned here."""
 
 import jax
 import jax.numpy as jnp
@@ -68,8 +70,14 @@ def test_stub_packages_raise_with_migration_pointers():
             mod.anything
         assert needle in str(e.value)
 
+    # multiproc graduated from stub to the real multi-host bootstrap:
+    # its CLI is now a launcher (argparse: no args -> usage exit 2); the
+    # worker-side bootstrap and the env protocol live in
+    # tests/test_multiproc.py
     from apex_tpu.parallel import multiproc
-    assert multiproc.main() == 1
+    with pytest.raises(SystemExit) as e:
+        multiproc.main([])
+    assert e.value.code == 2
 
 
 def test_pyprof_nvtx_era_names_keep_the_stub_contract():
