@@ -222,7 +222,7 @@ DOC = os.path.join("docs", "OBSERVABILITY.md")
 # meta-lint requires every slash-prefixed name to belong somewhere.
 METRIC_PREFIXES = ("health/", "tp/", "amp/", "ddp/", "pipeline/",
                    "optim/", "zero/", "mem/", "perf/", "ckpt/", "resume/",
-                   "serve/", "slo/", "elastic/")
+                   "serve/", "slo/", "elastic/", "fleet/", "train/")
 
 # slash-prefixed families that are deliberately OUTSIDE the doc-table
 # contract: jax/* (the compile-storm counters install_compile_listeners

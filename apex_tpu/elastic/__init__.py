@@ -19,9 +19,14 @@ The production run-loop layer over :class:`~apex_tpu.training
   iteration with a checkpointable cursor and double-buffered
   ``device_put`` prefetch.
 - :mod:`~apex_tpu.elastic.launch` — the localhost multi-process
-  launcher + elastic supervisor: heartbeat liveness, gang teardown,
-  bounded restart-with-backoff, and world-size **shrink** when a
-  process death is permanent (``elastic/*`` metrics).
+  launcher + elastic supervisor: heartbeat liveness AND step-progress
+  (stall) detection, gang teardown with a
+  :class:`~apex_tpu.observability.fleet.PostmortemReport` naming the
+  likely culprit rank, bounded restart-with-backoff, world-size
+  **shrink** when a process death is permanent (``elastic/*`` metrics),
+  and — via the :mod:`~apex_tpu.observability.fleet` merge layer — a
+  live ``/metrics``+``/fleet`` endpoint over the cross-rank merged
+  registry (``fleet/*`` straggler signals).
 - :mod:`~apex_tpu.elastic.reshard` — the cross-world-size restore math:
   bucket-major ZeRO flat shards re-partitioned dp_old → dp_new,
   element-identically on the natural flat-vector content.

@@ -35,6 +35,16 @@ through the elastic policy docs/ROBUSTNESS.md specifies:
 Metrics (host registry, docs/OBSERVABILITY.md): ``elastic/world_size``
 (gauge), ``elastic/restarts`` / ``elastic/shrinks`` (counters),
 ``elastic/heartbeat_age_s`` (gauge, max staleness over live ranks).
+Fleet layer (docs/OBSERVABILITY.md "Fleet observability"): the
+supervisor merges every rank's published registry snapshot
+(:class:`~apex_tpu.observability.fleet.FleetAggregator`) into the
+``fleet/*`` straggler gauges, writes a
+:class:`~apex_tpu.observability.fleet.PostmortemReport` under
+``run_dir/postmortem/`` on every non-ok round, and (``metrics_port``)
+serves the merged view on ``/metrics``+``/fleet``. The hang detector
+distinguishes liveness from PROGRESS: a rank whose heartbeat mtime
+keeps moving but whose reported step stays put for a full
+``heartbeat_timeout_s`` is declared stalled (cause ``"stall"``).
 
 Exit discipline: :func:`_supervisor_exit` is the ONE blessed process
 exit in this package besides ``AutoResume.request_resume`` — the CLI
@@ -77,13 +87,21 @@ def _free_port() -> int:
 class Heartbeat:
     """File-mtime heartbeat between one worker rank and the supervisor.
 
-    Worker side: ``Heartbeat(run_dir).beat(step)`` each step (atomic
-    tmp+rename write of ``"<step> <unix_time>"``). Supervisor side:
-    :meth:`age_s` reads staleness off the file mtime — no shared memory,
-    no sockets, works across SIGKILL (the file outlives the writer, so
-    the supervisor can also read :meth:`last_step` of a dead rank when
+    Worker side: ``Heartbeat(run_dir).beat(step)`` each step — an atomic
+    JSON payload (``{"schema", "step", "time"}``) into
+    ``rank_<r>.json`` FIRST, then the atomic tmp+rename mtime touch of
+    the legacy ``rank_<r>`` text file (``"<step> <unix_time>"``). The
+    ordering matters: the mtime file is the supervisor's change
+    detector, so by the time an mtime moves the step payload it vouches
+    for is already on disk — the progress (stall) detector never reads a
+    step older than the beat it observed. Supervisor side: :meth:`age_s`
+    reads staleness off the file mtime — no shared memory, no sockets,
+    works across SIGKILL (the files outlive the writer, so the
+    supervisor can also read :meth:`last_step` of a dead rank when
     deciding what the restart will resume from).
     """
+
+    SCHEMA = 1
 
     def __init__(self, run_dir: str, rank: Optional[int] = None):
         if rank is None:
@@ -93,6 +111,13 @@ class Heartbeat:
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
 
     def beat(self, step: int = 0) -> None:
+        import json
+        payload = {"schema": self.SCHEMA, "step": int(step),
+                   "time": time.time()}
+        tmp = f"{self.path}.json.tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, f"{self.path}.json")
         tmp = f"{self.path}.tmp"
         with open(tmp, "w") as f:
             f.write(f"{int(step)} {time.time()}\n")
@@ -115,7 +140,17 @@ class Heartbeat:
 
     @staticmethod
     def last_step(run_dir: str, rank: int) -> Optional[int]:
+        """The last completed step rank ``rank`` reported — read from
+        the JSON payload when present, falling back to the legacy text
+        format (external writers that only speak the text protocol stay
+        supported; pinned by the stub-worker launcher tests)."""
+        import json
         path = os.path.join(run_dir, _HB_DIR, f"rank_{rank}")
+        try:
+            with open(path + ".json") as f:
+                return int(json.load(f)["step"])
+        except (OSError, ValueError, KeyError, TypeError):
+            pass
         try:
             with open(path) as f:
                 return int(f.read().split()[0])
@@ -133,11 +168,15 @@ class Heartbeat:
 class RoundResult:
     """One gang launch: its world size, every rank's exit code (negative
     = killed by that signal; ``None`` never materializes — teardown
-    always reaps), and why the round ended."""
+    always reaps), why the round ended, and — for every non-ok round —
+    the path of the :class:`~apex_tpu.observability.fleet
+    .PostmortemReport` JSON written at teardown (the ``.md`` twin sits
+    next to it)."""
 
     world_size: int
     returncodes: Dict[int, int]
-    cause: str  # "ok" | "exit" | "heartbeat" | "timeout"
+    cause: str  # "ok" | "exit" | "heartbeat" | "stall" | "timeout"
+    postmortem: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -176,7 +215,9 @@ class LocalLauncher:
                  round_timeout_s: float = 900.0, grace_s: float = 5.0,
                  poll_s: float = 0.05,
                  env: Optional[Dict[str, str]] = None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 metrics_port: Optional[int] = None,
+                 fleet_refresh_s: float = 0.5):
         if num_processes < 1:
             raise ValueError("num_processes must be >= 1")
         if not 1 <= min_processes <= num_processes:
@@ -198,10 +239,20 @@ class LocalLauncher:
         self.poll_s = poll_s
         self.env = env
         reg = registry if registry is not None else get_registry()
+        self.registry = reg
         self._m_world = reg.gauge("elastic/world_size")
         self._m_restarts = reg.counter("elastic/restarts")
         self._m_shrinks = reg.counter("elastic/shrinks")
         self._m_hb_age = reg.gauge("elastic/heartbeat_age_s")
+        # the fleet layer (observability/fleet.py): rank snapshots merged
+        # into one registry (/metrics, fleet/* straggler gauges) and the
+        # gang postmortems written at teardown
+        from apex_tpu.observability.fleet import FleetAggregator
+        self.fleet = FleetAggregator(run_dir, registry=reg)
+        self.metrics_port = metrics_port
+        self.bound_metrics_port: Optional[int] = None
+        self.fleet_refresh_s = fleet_refresh_s
+        self._fleet_refreshed = 0.0  # monotonic of the last refresh
         os.makedirs(os.path.join(run_dir, "logs"), exist_ok=True)
 
     # -- one gang ---------------------------------------------------------
@@ -249,18 +300,23 @@ class LocalLauncher:
             p.wait()
 
     def _heartbeat_age(self, procs: List[subprocess.Popen],
-                       started: float, seen: Dict[int, tuple]) -> float:
+                       started: float, seen: Dict[int, list]) -> float:
         """Max staleness over ranks still running; a rank that never
         beat ages from the round start (it may be compiling — the
         timeout budget covers first-compile).
 
         The file mtime is used only as a CHANGE detector: ``seen`` maps
-        rank -> (last mtime observed, monotonic time of that
-        observation), and age is the monotonic delta since the mtime
-        last moved. Aging ``time.time() - st_mtime`` directly would mix
+        rank -> [last mtime observed, monotonic time of that
+        observation, last reported step, monotonic time the STEP last
+        advanced], and age is the monotonic delta since the mtime last
+        moved. Aging ``time.time() - st_mtime`` directly would mix
         the wall clock into a monotonic budget — an NTP step or VM
         suspend/resume larger than ``heartbeat_timeout_s`` would then
-        declare a perfectly healthy gang hung and tear it down."""
+        declare a perfectly healthy gang hung and tear it down.
+
+        The step columns feed :meth:`_stalled_ranks` — liveness (mtime
+        moving) is tracked separately from progress (step advancing),
+        because a rank wedged inside one step keeps beating forever."""
         now = time.monotonic()
         ages = []
         for rank, p in enumerate(procs):
@@ -274,18 +330,62 @@ class LocalLauncher:
                 continue
             last = seen.get(rank)
             if last is None or last[0] != mtime:
-                seen[rank] = (mtime, now)
+                step = Heartbeat.last_step(self.run_dir, rank)
+                if last is None or step is None or step != last[2]:
+                    seen[rank] = [mtime, now, step, now]
+                else:  # mtime moved, step did not: keep the step clock
+                    seen[rank] = [mtime, now, step, last[3]]
                 ages.append(0.0)
             else:
                 ages.append(now - last[1])
         return max(ages) if ages else 0.0
 
+    def _stalled_ranks(self, procs: List[subprocess.Popen],
+                       seen: Dict[int, list]) -> List[int]:
+        """Ranks whose heartbeat mtime keeps moving but whose reported
+        step has not advanced for a full ``heartbeat_timeout_s`` budget
+        — liveness is not progress (a worker spinning inside a wedged
+        collective, or deadlocked after a peer's silent failure, still
+        touches its heartbeat). The budget also covers first-compile:
+        the step clock starts at the first observed beat, exactly like
+        the never-beat clock starts at round start. Ranks whose
+        heartbeat carries no parseable step are exempt (external
+        writers may only speak the mtime protocol)."""
+        now = time.monotonic()
+        out = []
+        for rank, p in enumerate(procs):
+            if p.poll() is not None:
+                continue
+            last = seen.get(rank)
+            if last is None or last[2] is None:
+                continue
+            if now - last[3] > self.heartbeat_timeout_s:
+                out.append(rank)
+        return out
+
+    def _fleet_refresh(self, force: bool = False) -> None:
+        """Throttled fleet merge: publish the ``fleet/*`` straggler
+        gauges off the rank snapshots. Never lethal — the supervisor
+        must keep supervising even over a corrupt fleet dir."""
+        now = time.monotonic()
+        if not force and now - self._fleet_refreshed < self.fleet_refresh_s:
+            return
+        self._fleet_refreshed = now
+        try:
+            self.fleet.refresh()
+        except Exception:
+            pass
+
     def _run_round(self, world: int, round_idx: int) -> RoundResult:
         Heartbeat.clear(self.run_dir)
+        self.fleet.clear()  # rank files of the previous gang must not
+        # vouch for (or skew) this one — same rule as the heartbeats
         procs = self._spawn(world, round_idx)
         started = time.monotonic()
-        hb_seen: Dict[int, tuple] = {}
+        hb_seen: Dict[int, list] = {}
         cause = "timeout"
+        stalled: List[int] = []
+        pre_rcs: Dict[int, Optional[int]] = {}
         try:
             while True:
                 time.sleep(self.poll_s)
@@ -298,18 +398,76 @@ class LocalLauncher:
                     break
                 age = self._heartbeat_age(procs, started, hb_seen)
                 self._m_hb_age.set(age)
+                self._fleet_refresh()
                 if age > self.heartbeat_timeout_s:
                     cause = "heartbeat"
+                    break
+                stalled = self._stalled_ranks(procs, hb_seen)
+                if stalled:
+                    cause = "stall"
                     break
                 if time.monotonic() - started > self.round_timeout_s:
                     cause = "timeout"
                     break
         finally:
+            # exit codes BEFORE teardown: ranks the supervisor is about
+            # to SIGKILL must not be framed as self-dead in the
+            # postmortem (only a rank that died on its own carries a
+            # pre-teardown code)
+            pre_rcs = {r: p.poll() for r, p in enumerate(procs)}
             self._teardown(procs)
+        postmortem = None
+        if cause != "ok":
+            postmortem = self._write_postmortem(
+                round_idx, world, cause, pre_rcs, hb_seen, stalled,
+                started)
+        self._fleet_refresh(force=True)  # the final snapshots (ranks
+        # publish on exit) reach /metrics and the fleet/* gauges even
+        # after the gang is gone
         return RoundResult(
             world_size=world,
             returncodes={r: p.returncode for r, p in enumerate(procs)},
-            cause=cause)
+            cause=cause, postmortem=postmortem)
+
+    def _write_postmortem(self, round_idx: int, world: int, cause: str,
+                          pre_rcs: Dict[int, Optional[int]],
+                          hb_seen: Dict[int, list],
+                          stalled: List[int],
+                          started: float) -> Optional[str]:
+        """Harvest the dead gang into ``run_dir/postmortem/round<k>``
+        (strict JSON + markdown). Monotonic heartbeat ages come from the
+        supervisor's own change detector (``hb_seen``), not file
+        mtimes, so the culprit ordering survives wall-clock steps; a
+        rank that NEVER beat ages from the round start — the same clock
+        the hang detector used to tear the gang down, so a
+        wedged-before-first-beat rank is nameable as the culprit
+        instead of dissolving into "unknown". Failure to write is
+        logged into the report path as None, never raised — forensics
+        must not mask the failure being dissected."""
+        from apex_tpu.observability.fleet import PostmortemReport
+        now = time.monotonic()
+        ages = {}
+        for rank in range(world):
+            if rank in hb_seen:
+                ages[rank] = now - hb_seen[rank][1]
+            elif pre_rcs.get(rank) is None:
+                # alive pre-teardown and never beat: wedged before its
+                # first heartbeat (e.g. inside distributed init) — ages
+                # from round start, exactly like the hang detector aged
+                # it. A rank that EXITED without beating keeps no
+                # supervisor age (clean fast exits must not be framed).
+                ages[rank] = now - started
+        try:
+            report = PostmortemReport.collect(
+                self.run_dir, round_index=round_idx, world_size=world,
+                cause=cause, returncodes=pre_rcs, heartbeat_ages=ages,
+                stalled_ranks=stalled,
+                heartbeat_timeout_s=self.heartbeat_timeout_s)
+            json_path, _ = report.write(
+                os.path.join(self.run_dir, "postmortem"))
+            return json_path
+        except Exception:
+            return None
 
     # -- the supervisor loop ----------------------------------------------
     def run(self) -> LaunchReport:
@@ -321,39 +479,69 @@ class LocalLauncher:
         forensics (worker logs stay under ``run_dir/logs``), and the
         CLI maps it to exit code 1 through ``_supervisor_exit`` —
         exceptions out of ``run`` are reserved for real supervisor
-        failures."""
-        world = self.num_processes
-        restarts = shrinks = attempts_at_world = 0
-        rounds: List[RoundResult] = []
-        while True:
-            self._m_world.set(world)
-            result = self._run_round(world, len(rounds))
-            rounds.append(result)
-            if result.cause == "ok":
-                return LaunchReport(succeeded=True, world_size=world,
-                                    restarts=restarts, shrinks=shrinks,
-                                    rounds=rounds)
-            if attempts_at_world < self.max_restarts:
-                # transient-death policy: same world, backoff, relaunch
-                attempts_at_world += 1
-                restarts += 1
-                self._m_restarts.inc()
-                time.sleep(self.restart_backoff_s
-                           * (2.0 ** (attempts_at_world - 1)))
-                continue
-            # restart budget exhausted: the failure is permanent at this
-            # world size. A shrink is only a shrink if the smaller gang
-            # may actually launch — exhausting the policy AT
-            # min_processes must not count (or emit) a world-size
-            # reduction that never happened.
-            if world - 1 < self.min_processes:
-                return LaunchReport(
-                    succeeded=False, world_size=world,  # last world RUN
-                    restarts=restarts, shrinks=shrinks, rounds=rounds)
-            world -= 1
-            shrinks += 1
-            attempts_at_world = 0
-            self._m_shrinks.inc()
+        failures.
+
+        With ``metrics_port`` set (0 = ephemeral; the bound port lands
+        in ``bound_metrics_port``), the supervisor serves the MERGED
+        view for the whole run: ``/metrics`` renders its own
+        ``elastic/``+``fleet/`` registry combined with every rank
+        snapshot (counters summed, gauges averaged) in Prometheus text
+        format, ``/fleet`` returns the raw merged JSON. The server
+        lives in :mod:`apex_tpu.observability.fleet` and adds no
+        process-exit path to this package."""
+        server = None
+        if self.metrics_port is not None:
+            from apex_tpu.observability.fleet import MetricsServer
+
+            def _render() -> str:
+                # one disk read + one cross-rank merge per scrape, and
+                # the fleet/* gauges describe the same snapshot
+                # generation the rendered counters came from (two
+                # independent reads could straddle a rank's os.replace)
+                _, merged = self.fleet.scrape()
+                return merged.render_prometheus()
+
+            server = MetricsServer(_render, self.fleet.view,
+                                   port=self.metrics_port)
+            self.bound_metrics_port = server.start()
+        try:
+            world = self.num_processes
+            restarts = shrinks = attempts_at_world = 0
+            rounds: List[RoundResult] = []
+            while True:
+                self._m_world.set(world)
+                result = self._run_round(world, len(rounds))
+                rounds.append(result)
+                if result.cause == "ok":
+                    return LaunchReport(succeeded=True, world_size=world,
+                                        restarts=restarts,
+                                        shrinks=shrinks, rounds=rounds)
+                if attempts_at_world < self.max_restarts:
+                    # transient-death policy: same world, backoff,
+                    # relaunch
+                    attempts_at_world += 1
+                    restarts += 1
+                    self._m_restarts.inc()
+                    time.sleep(self.restart_backoff_s
+                               * (2.0 ** (attempts_at_world - 1)))
+                    continue
+                # restart budget exhausted: the failure is permanent at
+                # this world size. A shrink is only a shrink if the
+                # smaller gang may actually launch — exhausting the
+                # policy AT min_processes must not count (or emit) a
+                # world-size reduction that never happened.
+                if world - 1 < self.min_processes:
+                    return LaunchReport(
+                        succeeded=False, world_size=world,  # last RUN
+                        restarts=restarts, shrinks=shrinks,
+                        rounds=rounds)
+                world -= 1
+                shrinks += 1
+                attempts_at_world = 0
+                self._m_shrinks.inc()
+        finally:
+            if server is not None:
+                server.close()
 
 
 def main(argv=None) -> int:
@@ -371,6 +559,10 @@ def main(argv=None) -> int:
     ap.add_argument("--max-restarts", type=int, default=1)
     ap.add_argument("--heartbeat-timeout", type=float, default=300.0)
     ap.add_argument("--round-timeout", type=float, default=900.0)
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve the merged fleet registry over HTTP: "
+                         "/metrics (Prometheus text) and /fleet (raw "
+                         "merged JSON); 0 picks an ephemeral port")
     ap.add_argument("worker", nargs=argparse.REMAINDER,
                     help="worker command line (prefix with --)")
     args = ap.parse_args(argv)
@@ -389,7 +581,8 @@ def main(argv=None) -> int:
         devices_per_process=args.devices_per_process, run_dir=run_dir,
         min_processes=args.min_processes, max_restarts=args.max_restarts,
         heartbeat_timeout_s=args.heartbeat_timeout,
-        round_timeout_s=args.round_timeout)
+        round_timeout_s=args.round_timeout,
+        metrics_port=args.metrics_port)
     report = launcher.run()
     return 0 if report.succeeded else 1
 

@@ -35,9 +35,13 @@ shards through :mod:`apex_tpu.elastic.reshard` — see
 ``docs/ROBUSTNESS.md`` "Multi-host".
 
 Metrics (host registry): ``resume/restore_ms``, ``resume/restored_step``
-(gauges), ``resume/resumes``, ``resume/preempt_exits`` (counters), plus
-the ``ckpt/*`` family from :class:`~apex_tpu.elastic.ckpt
-.AsyncCheckpointer`.
+(gauges), ``resume/resumes``, ``resume/preempt_exits``, ``train/steps``
+(counters), plus the ``ckpt/*`` family from :class:`~apex_tpu.elastic
+.ckpt.AsyncCheckpointer`. A :class:`~apex_tpu.observability.fleet
+.FleetPublisher` passed as ``publisher`` snapshots the registry (and
+the completed-step counter) to ``run_dir/fleet/rank_<i>.json`` once per
+step — host-side only, the step program is byte-identical with it on
+or off (asserted in ``tests/test_fleet.py``).
 """
 
 from __future__ import annotations
@@ -123,7 +127,11 @@ class ElasticRunner:
     step loop and the checkpointer. ``exit_on_preempt=False`` makes a
     preemption return a ``FitResult(preempted=True)`` instead of calling
     ``AutoResume.request_resume`` (in-process tests; production keeps the
-    exit-0-so-the-scheduler-restarts default).
+    exit-0-so-the-scheduler-restarts default). ``publisher`` attaches a
+    :class:`~apex_tpu.observability.fleet.FleetPublisher`: one snapshot
+    per completed step (throttled by its ``min_interval_s``) plus a
+    forced final one on both exit paths, so the supervisor's merged view
+    and postmortems always see this rank's last state.
     """
 
     def __init__(self, trainer: Any, data: Any, directory: str, *,
@@ -134,7 +142,8 @@ class ElasticRunner:
                  registry: Optional[MetricsRegistry] = None,
                  exit_on_preempt: bool = True, final_save: bool = True,
                  on_step: Optional[Callable[[int, Any], None]] = None,
-                 checkpointer: Optional[AsyncCheckpointer] = None):
+                 checkpointer: Optional[AsyncCheckpointer] = None,
+                 publisher: Optional[Any] = None):
         if save_interval < 1:
             raise ValueError("save_interval must be >= 1")
         self.trainer = trainer
@@ -146,8 +155,12 @@ class ElasticRunner:
         self.exit_on_preempt = exit_on_preempt
         self.final_save = final_save
         self.on_step = on_step
+        self.publisher = publisher
         self._registry = (registry if registry is not None
                           else get_registry())
+        # the fleet snapshot's "completed steps" counter: host-side, no
+        # device sync (the loss is deliberately NOT fetched per step)
+        self._m_steps = self._registry.counter("train/steps")
         # multi-controller worlds checkpoint collectively+synchronously
         # (device_get cannot snapshot shards other processes own); the
         # async off-thread split stays the single-controller default
@@ -399,6 +412,10 @@ class ElasticRunner:
                                host_state=self._host_state(step),
                                block=True)
         self._registry.counter("resume/preempt_exits").inc()
+        if self.publisher is not None:
+            # the final snapshot must beat the exit: the supervisor's
+            # postmortem reads it after this process is gone
+            self.publisher.publish(step, force=True)
         if self.exit_on_preempt:
             ar.request_resume()  # sys.exit(0): scheduler restarts the job
         return FitResult(state=state, step=step,
@@ -467,6 +484,9 @@ class ElasticRunner:
                     loss, *state = step_fn(*state, *batch)
                     state = tuple(state)
                     step += 1
+                    self._m_steps.inc()
+                    if self.publisher is not None:
+                        self.publisher.publish(step)
                     if self.on_step is not None:
                         self.on_step(step, loss)
                     saved = False
@@ -502,6 +522,8 @@ class ElasticRunner:
                 self.ckpt.save(state, step,
                                host_state=self._host_state(step),
                                block=True)
+            if self.publisher is not None:
+                self.publisher.publish(step, force=True)
             return FitResult(state=state, step=step,
                              loss=None if loss is None else float(loss),
                              preempted=False, restored_from=restored_from,

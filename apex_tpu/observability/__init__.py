@@ -6,7 +6,7 @@ The reference exposes runtime behavior only through ad-hoc prints (amp's
 structured replacement: one stream that answers "what did this step spend,
 where, on which rank" without a trace capture.
 
-Eight modules, composable and each zero-cost when unused:
+Composable modules, each zero-cost when unused:
 
 - :mod:`~apex_tpu.observability.registry` — host-side counters, gauges and
   fixed-bucket histograms (``Metric.observe()``), grouped in a
@@ -35,7 +35,14 @@ Eight modules, composable and each zero-cost when unused:
   latencies, a bounded flight-recorder ring with a per-slot-swimlane
   Chrome-trace export, and :class:`SLOTracker` — declarative latency
   targets, rolling goodput/burn-rate gauges (``slo/*``), and a
-  flight-recorder :class:`CrashDump` on violation.
+  flight-recorder :class:`CrashDump` on violation;
+- :mod:`~apex_tpu.observability.fleet` — the cross-rank merge layer:
+  rank-side registry snapshots (:class:`FleetPublisher`, atomic JSON),
+  the supervisor-side :class:`FleetAggregator` (counters sum, gauges
+  min/max/mean + spread, histogram buckets add) with the ``fleet/*``
+  straggler family, :class:`PostmortemReport` gang forensics, and a
+  stdlib :class:`MetricsServer` serving ``/metrics`` (Prometheus text
+  via ``render_prometheus``) + ``/fleet`` (merged JSON).
 
 Hot paths in the library are pre-instrumented (``amp/*``, ``ddp/*``,
 ``pipeline/*``, ``optim/*``, ``health/*`` — see ``docs/OBSERVABILITY.md``);
@@ -48,7 +55,8 @@ from apex_tpu.observability.registry import (  # noqa: F401
 from apex_tpu.observability.ingraph import (  # noqa: F401
     Metrics, aggregate, collecting, reap, record, recording)
 from apex_tpu.observability.trace import (  # noqa: F401
-    Span, chrome_trace_events, drain_spans, span_recording, spans_enabled)
+    Span, chrome_trace_events, drain_spans, epoch_offset,
+    merge_chrome_traces, span_recording, spans_enabled)
 from apex_tpu.observability.sinks import (  # noqa: F401
     ChromeTraceSink, JSONLSink, TensorBoardSink)
 from apex_tpu.observability.report import (  # noqa: F401
@@ -66,3 +74,6 @@ from apex_tpu.observability.reqtrace import (  # noqa: F401
     LATENCY_BUCKETS_MS, RequestRecord, RequestTrace, chrome_request_trace)
 from apex_tpu.observability.slo import (  # noqa: F401
     SLOTarget, SLOTracker, SLOViolationError)
+from apex_tpu.observability.fleet import (  # noqa: F401
+    FleetAggregator, FleetPublisher, MetricsServer, PostmortemReport,
+    merge_registry_dicts)

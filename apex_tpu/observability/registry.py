@@ -15,10 +15,11 @@ import bisect
 import math
 import re
 import threading
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "get_registry", "DEFAULT_BUCKETS", "log_buckets"]
+           "get_registry", "DEFAULT_BUCKETS", "log_buckets",
+           "json_safe_float", "json_float"]
 
 # power-of-4 spread from sub-millisecond to minutes — wide enough for both
 # durations (seconds) and sizes (use explicit buckets for bytes)
@@ -299,6 +300,54 @@ class MetricsRegistry:
         for m in self._metrics.values():
             m.reset()
 
+    # -- typed serialization (the fleet snapshot format) -------------------
+    def to_dict(self) -> dict:
+        """A TYPED, strict-JSON-safe dict of the whole registry — unlike
+        :meth:`snapshot` (flat floats, which cannot be merged: a
+        histogram's bucket counts and a gauge's last value need different
+        merge rules), this carries each metric's kind and full state, so
+        another process can rebuild (:meth:`from_dict`) or merge
+        (:func:`~apex_tpu.observability.fleet.merge_registry_dicts`) it.
+        Non-finite values use the string spellings ``"NaN"`` /
+        ``"Infinity"`` / ``"-Infinity"`` (strict-JSON contract, same as
+        the crash dumps); never-set gauges are skipped (same contract as
+        :meth:`snapshot`)."""
+        with self._lock:
+            metrics = list(self._metrics.items())
+        out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+        for name, m in metrics:
+            if isinstance(m, Counter):
+                out["counters"][name] = json_safe_float(m.value)
+            elif isinstance(m, Gauge):
+                if m.is_set:
+                    out["gauges"][name] = json_safe_float(m.value)
+            elif isinstance(m, Histogram):
+                out["histograms"][name] = {
+                    "bounds": list(m.bounds),
+                    "counts": list(m._counts),
+                    "sum": json_safe_float(m._sum),
+                    "count": int(m._count),
+                    "min": json_safe_float(m._min),
+                    "max": json_safe_float(m._max),
+                }
+        return out
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "MetricsRegistry":
+        """Rebuild a registry from :meth:`to_dict` output. Histograms
+        restore their per-bucket counts AND the observed min/max/sum, so
+        :meth:`Histogram.percentile` answers the same after a round-trip
+        (asserted in ``tests/test_fleet.py``)."""
+        reg = cls()
+        for name, value in doc.get("counters", {}).items():
+            reg.counter(name).inc(json_float(value))
+        for name, value in doc.get("gauges", {}).items():
+            reg.gauge(name).set(json_float(value))
+        for name, h in doc.get("histograms", {}).items():
+            hist = reg.histogram(name, h["bounds"])
+            _restore_histogram(hist, h)
+        return reg
+
     def render_prometheus(self) -> str:
         """The registry in Prometheus text exposition format, so a host
         process can serve the snapshot on a ``/metrics`` endpoint and be
@@ -335,6 +384,39 @@ class MetricsRegistry:
                 lines.append(f"{pn}_sum {_prometheus_value(m.sum)}")
                 lines.append(f"{pn}_count {m.count}")
         return "\n".join(lines) + ("\n" if lines else "")
+
+
+def json_safe_float(value: float) -> Any:
+    """Strict-JSON spelling of one float: non-finite values become the
+    strings ``"NaN"``/``"Infinity"``/``"-Infinity"`` (the crash-dump
+    contract — ``json.dump(..., allow_nan=False)`` then round-trips)."""
+    value = float(value)
+    if math.isnan(value):
+        return "NaN"
+    if math.isinf(value):
+        return "Infinity" if value > 0 else "-Infinity"
+    return value
+
+
+def json_float(value: Any) -> float:
+    """Inverse of :func:`json_safe_float`: accepts the string spellings
+    back (``float("NaN")``/``float("Infinity")`` parse them natively)."""
+    return float(value)
+
+
+def _restore_histogram(hist: Histogram, doc: dict) -> None:
+    """Overwrite ``hist``'s internal state from a serialized dict whose
+    ``bounds`` already match (``from_dict`` creates it that way)."""
+    counts = [int(c) for c in doc["counts"]]
+    if len(counts) != len(hist.bounds) + 1:
+        raise ValueError(
+            f"histogram {hist.name!r}: {len(counts)} counts for "
+            f"{len(hist.bounds)} bounds (+1 overflow expected)")
+    hist._counts = counts
+    hist._sum = json_float(doc["sum"])
+    hist._count = int(doc["count"])
+    hist._min = json_float(doc["min"])
+    hist._max = json_float(doc["max"])
 
 
 _PROM_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")

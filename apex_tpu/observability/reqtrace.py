@@ -42,6 +42,7 @@ import threading
 from typing import Any, Dict, Iterable, List, Optional
 
 from apex_tpu.observability.registry import log_buckets
+from apex_tpu.observability.trace import trace_metadata
 
 __all__ = ["RequestRecord", "RequestTrace", "chrome_request_trace",
            "LATENCY_BUCKETS_MS"]
@@ -219,8 +220,12 @@ def chrome_request_trace(records: Iterable[RequestRecord], pid: int = 0,
     Timestamps are ``perf_counter``-derived microseconds — the same
     timebase as :func:`~apex_tpu.observability.trace.chrome_trace_events`
     spans and the ``ChromeTraceSink`` counters, so a host-step trace and
-    a request trace line up when loaded together. The returned document
-    is strict JSON (round-trips ``json.loads``; asserted in tests).
+    a request trace line up when loaded together. Cross-PROCESS
+    alignment rides the ``metadata.epoch_offset_s`` stamp (see
+    :func:`~apex_tpu.observability.trace.merge_chrome_traces`): two
+    ranks' perf_counter zero points are unrelated, and the offset is
+    what recovers a shared timeline. The returned document is strict
+    JSON (round-trips ``json.loads``; asserted in tests).
     """
     records = list(records)
     events: List[dict] = [
@@ -264,4 +269,5 @@ def chrome_request_trace(records: Iterable[RequestRecord], pid: int = 0,
                 events.append({"name": "tick", "ph": "i", "s": "t",
                                "cat": "serve", "ts": t * 1e6, "pid": pid,
                                "tid": tid, "args": {"request_id": rid}})
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "metadata": trace_metadata()}

@@ -21,7 +21,9 @@ import os
 import time
 from typing import Any, Dict, Iterable, Optional, Sequence, Union
 
-from apex_tpu.observability.trace import Span, chrome_trace_events
+from apex_tpu.observability.registry import json_safe_float
+from apex_tpu.observability.trace import (Span, chrome_trace_events,
+                                          trace_metadata)
 
 __all__ = ["Sink", "JSONLSink", "TensorBoardSink", "ChromeTraceSink",
            "json_safe_value", "json_safe_metrics"]
@@ -31,11 +33,13 @@ def json_safe_value(value: Any) -> Any:
     """Non-finite floats as the strings ``"NaN"``/``"Infinity"``/
     ``"-Infinity"`` — health metrics legitimately carry them (a NaN
     abs-max IS the signal), and Python's default ``json`` emits bare
-    non-standard literals that jq/``JSON.parse``/Go reject wholesale."""
+    non-standard literals that jq/``JSON.parse``/Go reject wholesale.
+    The one spelling contract lives in
+    :func:`~apex_tpu.observability.registry.json_safe_float` (shared
+    with the fleet snapshot serialization); this wrapper just passes
+    non-float values through untouched."""
     if isinstance(value, float) and not math.isfinite(value):
-        if math.isnan(value):
-            return "NaN"
-        return "Infinity" if value > 0 else "-Infinity"
+        return json_safe_float(value)
     return value
 
 
@@ -130,6 +134,11 @@ class ChromeTraceSink(Sink):
         self.pid = pid
         self._counters = counters
         self._events = []
+        # the cross-process timebase anchor: ts fields are perf_counter
+        # microseconds (process-local zero), and this offset is what lets
+        # trace.merge_chrome_traces align several ranks' files into one
+        # Perfetto view (sampled once — the clocks only NTP-slew apart)
+        self._metadata = trace_metadata()
 
     def emit(self, step, metrics, spans=()):
         self._events.extend(
@@ -147,4 +156,5 @@ class ChromeTraceSink(Sink):
     def close(self):
         with open(self.path, "w") as f:
             json.dump({"traceEvents": self._events,
-                       "displayTimeUnit": "ms"}, f, allow_nan=False)
+                       "displayTimeUnit": "ms",
+                       "metadata": self._metadata}, f, allow_nan=False)

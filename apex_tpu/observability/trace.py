@@ -16,11 +16,13 @@ from __future__ import annotations
 
 import contextlib
 import threading
-from typing import List, NamedTuple, Optional
+import time
+from typing import Iterable, List, NamedTuple, Optional
 
 __all__ = ["Span", "spans_enabled", "enable_spans", "disable_spans",
            "record_span", "drain_spans", "span_recording",
-           "chrome_trace_events"]
+           "chrome_trace_events", "epoch_offset", "trace_metadata",
+           "merge_chrome_traces"]
 
 
 class Span(NamedTuple):
@@ -83,6 +85,85 @@ def span_recording():
     finally:
         if not was:
             disable_spans()
+
+
+def epoch_offset() -> float:
+    """``time.time() − time.perf_counter()`` — the translation from this
+    process's ``perf_counter`` timebase to the shared unix epoch.
+
+    Every span/tick in the Chrome exports is stamped in ``perf_counter``
+    seconds, whose zero point is *process-local* and arbitrary: two
+    ranks' traces loaded together would land decades apart (or overlap
+    meaninglessly). Stamping this offset into each trace's metadata
+    makes the per-rank timebases recoverable after the fact, so
+    :func:`merge_chrome_traces` can re-stamp every event onto one shared
+    (epoch) timeline for a multi-rank Perfetto view. Sampled at call
+    time; the two clocks drift only at NTP-slew rates, far below span
+    resolution over a trace's lifetime."""
+    return time.time() - time.perf_counter()
+
+
+def trace_metadata() -> dict:
+    """The metadata block both Chrome exporters stamp into their
+    documents: the clock the ``ts`` fields are in plus the epoch offset
+    that aligns it across processes."""
+    return {"clock": "perf_counter", "epoch_offset_s": epoch_offset()}
+
+
+def merge_chrome_traces(docs: Iterable[dict]) -> dict:
+    """Merge per-rank Chrome-trace documents into one aligned view.
+
+    Each input must carry ``metadata.epoch_offset_s`` (both exporters
+    stamp it); every event's ``ts`` is shifted by its document's offset,
+    so all events land on the shared epoch-microseconds timeline —
+    cross-rank ordering becomes meaningful even though each rank stamped
+    its own ``perf_counter``. A document missing the offset raises —
+    silently merging unaligned timebases is the bug this function
+    exists to prevent.
+
+    Pids: both exporters default to ``pid=0``, so two ranks' files
+    usually COLLIDE — merged as-is their spans would interleave in one
+    indistinguishable lane. When any pid appears in more than one
+    document, every ``(document, pid)`` pair is re-stamped to a fresh
+    pid (document order, then pid order), keeping each source's
+    internal pid structure while separating the sources; collision-free
+    inputs keep their pids verbatim. The merged document's metadata
+    records ``clock: "epoch"`` with offset 0.
+    """
+    docs = list(docs)
+    for i, doc in enumerate(docs):
+        meta = doc.get("metadata") or {}
+        if "epoch_offset_s" not in meta:
+            raise ValueError(
+                f"trace document {i} carries no metadata.epoch_offset_s "
+                f"— cannot align its process-local perf_counter timebase")
+    doc_pids = [{ev.get("pid", 0) for ev in doc.get("traceEvents", [])}
+                for doc in docs]
+    seen: set = set()
+    collide = False
+    for pids in doc_pids:
+        if pids & seen:
+            collide = True
+            break
+        seen |= pids
+    remap: dict = {}
+    if collide:
+        for i, pids in enumerate(doc_pids):
+            for p in sorted(pids, key=repr):
+                remap[(i, p)] = len(remap)
+    events: List[dict] = []
+    for i, doc in enumerate(docs):
+        shift_us = float(doc["metadata"]["epoch_offset_s"]) * 1e6
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + shift_us
+            if collide:
+                ev["pid"] = remap[(i, ev.get("pid", 0))]
+            events.append(ev)
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "metadata": {"clock": "epoch", "epoch_offset_s": 0.0}}
 
 
 def chrome_trace_events(spans, pid: int = 0, tid: int = 0,

@@ -23,7 +23,9 @@ from apex_tpu.training import GPTHybridTrainer
 from apex_tpu.transformer import parallel_state
 
 
-def main(argv=None):
+def main(argv=None, on_metrics=None):
+    """``on_metrics`` (tests): called with the server's base URL while
+    the ``--metrics-port`` endpoint is still live, after training."""
     ap = argparse.ArgumentParser()
     ap.add_argument("--tp", type=int, default=2)
     ap.add_argument("--pp", type=int, default=2)
@@ -82,6 +84,14 @@ def main(argv=None):
                          "and — at tp>1, pp==1 on VMA jax — "
                          "sequence-parallel tp_comm_overlap "
                          "(docs/PERF.md 'Flagship tuning')")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve the local metrics registry over HTTP "
+                         "while training: /metrics in Prometheus text "
+                         "exposition (registry.render_prometheus — the "
+                         "single-process face of the fleet endpoint the "
+                         "elastic supervisor serves; docs/"
+                         "OBSERVABILITY.md 'Fleet observability'); 0 "
+                         "picks an ephemeral port")
     args = ap.parse_args(argv)
     if args.tp_comm_overlap:
         args.sequence_parallel = True
@@ -111,65 +121,95 @@ def main(argv=None):
         # pyprof roofline resolves "auto" at trainer construction
         cfg = cfg.fastpath()
 
-    mesh = cfg.initialize_mesh()
-    trainer = GPTHybridTrainer(cfg, mesh)
-    calc = cfg.build_microbatch_calculator(dp)
-    assert calc.get() == M
-    rng = np.random.RandomState(0)
-    data = rng.randint(0, args.vocab, (10_000, seq + 1))
+    server = metrics_registry = None
+    if args.metrics_port is not None:
+        # the single-process face of the supervisor's fleet endpoint:
+        # serve THIS process's registry (render_prometheus) — same route,
+        # no aggregation layer needed at world size 1
+        from apex_tpu.observability import get_registry
+        from apex_tpu.observability.fleet import MetricsServer
+        metrics_registry = get_registry()
+        server = MetricsServer(metrics_registry.render_prometheus,
+                               port=args.metrics_port)
+        port = server.start()
+        print(f"serving /metrics on http://127.0.0.1:{port}/metrics")
 
-    if args.checkpoint_dir:
-        # elastic path: seeded resumable sharded data + async checkpoints
-        # + preemption-safe loop; restart the same command line to resume
-        from jax.sharding import NamedSharding, PartitionSpec as P
+    def _finish(result):
+        if server is not None:
+            if on_metrics is not None:
+                on_metrics(server.url)
+            server.close()
+        return result
 
-        from apex_tpu.elastic import (ElasticRunner, PrefetchingIterator,
-                                      ShardedIndexIterator,
-                                      token_batch_fetcher)
-        it = PrefetchingIterator(
-            ShardedIndexIterator(10_000, M * dp * mb, seed=0),
-            token_batch_fetcher(data, M, dp * mb, seq), depth=2,
-            sharding=NamedSharding(mesh, P(None, "data")))
+    # everything below runs under the server's try/finally:
+    # the exception path must not leak the listening socket
+    # (_finish already closed it on the success paths; close()
+    # is idempotent)
+    try:
+        mesh = cfg.initialize_mesh()
+        trainer = GPTHybridTrainer(cfg, mesh)
+        calc = cfg.build_microbatch_calculator(dp)
+        assert calc.get() == M
+        rng = np.random.RandomState(0)
+        data = rng.randint(0, args.vocab, (10_000, seq + 1))
+
+        if args.checkpoint_dir:
+            # elastic path: seeded resumable sharded data + async checkpoints
+            # + preemption-safe loop; restart the same command line to resume
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from apex_tpu.elastic import (ElasticRunner, PrefetchingIterator,
+                                          ShardedIndexIterator,
+                                          token_batch_fetcher)
+            it = PrefetchingIterator(
+                ShardedIndexIterator(10_000, M * dp * mb, seed=0),
+                token_batch_fetcher(data, M, dp * mb, seq), depth=2,
+                sharding=NamedSharding(mesh, P(None, "data")))
+            try:
+                runner = ElasticRunner(
+                    trainer, it, args.checkpoint_dir,
+                    save_interval=args.save_interval,
+                    keep_last=args.keep_last,
+                    on_step=lambda k, loss: print(f"step {k}: loss "
+                                                  f"{float(loss):.4f}"))
+                res = runner.fit(args.steps, key=jax.random.PRNGKey(0))
+            finally:
+                parallel_state.destroy_model_parallel()
+            return _finish(res.loss)
+
+        state = list(trainer.init_state(jax.random.PRNGKey(0)))
+
+        # Megatron sampler drives the host data order
+        sampler = cfg.build_sampler(total_samples=10_000, consumed_samples=0,
+                                    data_parallel_rank=0, data_parallel_size=1,
+                                    shuffle=True)
+        batches = iter(sampler)
+
+        # donated jit: stage/shared/opt_state update in place — the loop below
+        # only ever touches the returned state, never a consumed buffer
+        step_fn = trainer.jit_train_step()
+        loss = None
         try:
-            runner = ElasticRunner(
-                trainer, it, args.checkpoint_dir,
-                save_interval=args.save_interval,
-                keep_last=args.keep_last,
-                on_step=lambda k, loss: print(f"step {k}: loss "
-                                              f"{float(loss):.4f}"))
-            res = runner.fit(args.steps, key=jax.random.PRNGKey(0))
+            for i in range(args.steps):
+                # one sampler batch == one global batch (M * dp * mb rows);
+                # native memcpy row-gather packs it
+                from apex_tpu._native import gather_rows
+                rows = next(batches)
+                chunk = gather_rows(data, rows).reshape(M, dp * mb, seq + 1)
+                tokens = jnp.asarray(chunk[..., :-1])
+                targets = jnp.asarray(chunk[..., 1:])
+                loss, *state = step_fn(*state, tokens, targets)
+                if metrics_registry is not None:
+                    metrics_registry.counter("train/steps").inc()
+                ls = state[-1]
+                print(f"step {i}: loss {float(loss):.4f} "
+                      f"scale {float(ls.loss_scale):.0f}")
         finally:
             parallel_state.destroy_model_parallel()
-        return res.loss
-
-    state = list(trainer.init_state(jax.random.PRNGKey(0)))
-
-    # Megatron sampler drives the host data order
-    sampler = cfg.build_sampler(total_samples=10_000, consumed_samples=0,
-                                data_parallel_rank=0, data_parallel_size=1,
-                                shuffle=True)
-    batches = iter(sampler)
-
-    # donated jit: stage/shared/opt_state update in place — the loop below
-    # only ever touches the returned state, never a consumed buffer
-    step_fn = trainer.jit_train_step()
-    loss = None
-    try:
-        for i in range(args.steps):
-            # one sampler batch == one global batch (M * dp * mb rows);
-            # native memcpy row-gather packs it
-            from apex_tpu._native import gather_rows
-            rows = next(batches)
-            chunk = gather_rows(data, rows).reshape(M, dp * mb, seq + 1)
-            tokens = jnp.asarray(chunk[..., :-1])
-            targets = jnp.asarray(chunk[..., 1:])
-            loss, *state = step_fn(*state, tokens, targets)
-            ls = state[-1]
-            print(f"step {i}: loss {float(loss):.4f} "
-                  f"scale {float(ls.loss_scale):.0f}")
+        return _finish(float(loss))
     finally:
-        parallel_state.destroy_model_parallel()
-    return float(loss)
+        if server is not None:
+            server.close()
 
 
 if __name__ == "__main__":

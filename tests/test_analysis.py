@@ -175,17 +175,24 @@ def _plant_metrics_doc(tmp_path):
            "    reg.gauge('slo/rogue_goodput').set(x)\n"
            # the PR 13 supervisor family: elastic/* is under the doc
            # contract like every other elastic-runtime family
-           "    reg.gauge('elastic/rogue_world').set(x)\n")
+           "    reg.gauge('elastic/rogue_world').set(x)\n"
+           # the PR 14 fleet merge layer: fleet/* (supervisor straggler
+           # gauges) and train/* (rank-side step counters) join the
+           # contract
+           "    reg.gauge('fleet/rogue_skew').set(x)\n"
+           "    reg.counter('train/rogue_steps').inc(x)\n")
     _write(tmp_path, "docs/OBSERVABILITY.md", "| nothing documented |\n")
 
 
 def _expect_metrics_doc(findings):
     undoc = [f for f in findings if f.kind == "UNDOC"]
-    assert len(undoc) == 8  # record x2 + gauge x3 + counter + hist x2
+    # record x2 + gauge x4 + counter x2 + hist x2
+    assert len(undoc) == 10
     for name in ("health/rogue_metric", "health/<>/rogue_family",
                  "perf/rogue_attribution", "ckpt/rogue_bytes",
                  "serve/rogue_ms", "serve/rogue_wait_ms",
-                 "slo/rogue_goodput", "elastic/rogue_world"):
+                 "slo/rogue_goodput", "elastic/rogue_world",
+                 "fleet/rogue_skew", "train/rogue_steps"):
         assert any(name in f.message for f in undoc), name
 
 
@@ -198,6 +205,8 @@ def _plant_metric_family(tmp_path):
            "    reg.gauge('serve/queue_depth').set(x)\n"       # known
            "    reg.gauge('slo/goodput').set(x)\n"             # known (PR 12)
            "    reg.gauge('elastic/world_size').set(x)\n"      # known (PR 13)
+           "    reg.gauge('fleet/step_skew').set(x)\n"         # known (PR 14)
+           "    reg.counter('train/steps').inc()\n"            # known (PR 14)
            "    reg.gauge('no_slash_name').set(x)\n")          # unprefixed
     # even a documented row does not excuse an unregistered FAMILY
     _write(tmp_path, "docs/OBSERVABILITY.md", "| `newfam/widgets` |\n")
@@ -424,7 +433,8 @@ def test_documenting_fixes_metrics_doc(tmp_path):
            "| `health/rogue_metric` | `health/<tree>/rogue_family` |\n"
            "| `perf/rogue_attribution` | `ckpt/rogue_bytes` |\n"
            "| `serve/rogue_ms` | `serve/rogue_wait_ms` |\n"
-           "| `slo/rogue_goodput` | `elastic/rogue_world` |\n")
+           "| `slo/rogue_goodput` | `elastic/rogue_world` |\n"
+           "| `fleet/rogue_skew` | `train/rogue_steps` |\n")
     findings, _ = rule_metrics_doc(str(tmp_path))
     assert not findings
 

@@ -30,10 +30,41 @@ def test_imagenet_amp_runs_and_resumes(tmp_path):
     assert np.isfinite(loss)
 
 
-def test_gpt_pretrain_runs():
+def test_gpt_pretrain_runs_and_serves_metrics_port():
+    """The pretrain example, also exercising --metrics-port (one run,
+    not two — tier-1 budget): the single-process face of the fleet
+    endpoint. /metrics serves the LOCAL registry in Prometheus text
+    exposition over a real HTTP round-trip while the server is live
+    (port 0 = ephemeral), carrying the train-side step counter."""
+    import urllib.request
+
     import gpt_pretrain
-    loss = gpt_pretrain.main(["--tp", "2", "--pp", "2", "--steps", "2"])
+
+    from apex_tpu.observability import get_registry
+
+    get_registry().counter("train/steps").reset()
+    seen = {}
+
+    def fetch(base_url):
+        with urllib.request.urlopen(base_url + "/metrics",
+                                    timeout=10) as r:
+            seen["status"] = r.status
+            seen["text"] = r.read().decode()
+
+    loss = gpt_pretrain.main(["--tp", "2", "--pp", "2", "--steps", "2",
+                              "--metrics-port", "0"], on_metrics=fetch)
     assert loss > 0
+    assert seen["status"] == 200
+    text = seen["text"]
+    assert "train_steps 2" in text
+    # parses as Prometheus text exposition: every sample line is
+    # "name value" with a float-spellable value
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        assert name, line
+        float(value)
 
 
 def test_gpt_pretrain_zero_runs():

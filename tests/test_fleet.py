@@ -1,0 +1,597 @@
+"""Fleet observability: registry serialization + merge, the rank-side
+publisher, the supervisor aggregator's straggler signals, the
+``/metrics``+``/fleet`` HTTP endpoint, gang postmortems, and the
+cross-rank trace-timebase alignment.
+
+The byte-identical-programs contract (publisher on vs off changes
+NOTHING on the device) is asserted here the way PR 12 asserts request
+tracing; the launcher-level integration (stall cause, postmortem
+wiring, live supervisor scrape) lives in ``tests/test_multiproc.py``
+next to the rest of the supervisor policy tests.
+"""
+
+import json
+import math
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from apex_tpu.observability.fleet import (FleetAggregator, FleetPublisher,
+                                          MetricsServer, PostmortemReport,
+                                          merge_registry_dicts,
+                                          snapshot_path)
+from apex_tpu.observability.registry import (MetricsRegistry, log_buckets)
+from apex_tpu.observability import trace as trace_mod
+from apex_tpu.observability.sinks import ChromeTraceSink
+
+
+# ---------------------------------------------------------------------------
+# registry serialization: snapshot -> JSON -> merge round-trip
+# ---------------------------------------------------------------------------
+
+class TestRegistrySerialization:
+    def _populated(self):
+        reg = MetricsRegistry()
+        reg.counter("train/steps").inc(7)
+        reg.gauge("perf/step_wall_ms").set(12.5)
+        reg.gauge("never_set")                      # must be skipped
+        reg.gauge("health/grads/abs_max").set(float("inf"))
+        h = reg.histogram("serve/ttft_ms", [1.0, 10.0, 100.0])
+        for v in (0.5, 3.0, 40.0, 400.0):
+            h.observe(v)
+        return reg
+
+    def test_round_trip_is_strict_json_and_value_identical(self):
+        reg = self._populated()
+        doc = reg.to_dict()
+        # strict JSON: the inf gauge serializes as a string spelling
+        text = json.dumps(doc, allow_nan=False)
+        back = MetricsRegistry.from_dict(json.loads(text))
+        assert back.snapshot() == reg.snapshot()
+        assert back.gauge("health/grads/abs_max").value == float("inf")
+
+    def test_unset_gauge_skipped_nan_gauge_kept(self):
+        reg = MetricsRegistry()
+        reg.gauge("unset")
+        reg.gauge("bad").set(float("nan"))
+        doc = reg.to_dict()
+        assert "unset" not in doc["gauges"]
+        assert doc["gauges"]["bad"] == "NaN"
+        back = MetricsRegistry.from_dict(doc)
+        assert math.isnan(back.gauge("bad").value)
+        assert not back.gauge("unset").is_set
+
+    def test_histogram_round_trip_preserves_percentiles(self):
+        reg = self._populated()
+        h = reg.histogram("serve/ttft_ms", [1.0, 10.0, 100.0])
+        back = MetricsRegistry.from_dict(reg.to_dict()) \
+            .histogram("serve/ttft_ms", [1.0, 10.0, 100.0])
+        for q in (0, 25, 50, 90, 100):
+            assert back.percentile(q) == h.percentile(q)
+        assert back.count == h.count and back.sum == h.sum
+
+    def test_bad_histogram_counts_rejected(self):
+        reg = self._populated()
+        doc = reg.to_dict()
+        doc["histograms"]["serve/ttft_ms"]["counts"] = [1, 2]
+        with pytest.raises(ValueError, match="counts"):
+            MetricsRegistry.from_dict(doc)
+
+
+class TestMerge:
+    def test_counters_sum_gauges_spread_buckets_add(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.counter("train/steps").inc(3)
+        b.counter("train/steps").inc(5)
+        a.gauge("perf/step_wall_ms").set(10.0)
+        b.gauge("perf/step_wall_ms").set(30.0)
+        ha = a.histogram("io/ms", [1.0, 10.0])
+        hb = b.histogram("io/ms", [1.0, 10.0])
+        ha.observe(0.5), ha.observe(5.0)
+        hb.observe(5.0), hb.observe(50.0)
+        merged, stats = merge_registry_dicts([a.to_dict(), b.to_dict()])
+        snap = merged.snapshot()
+        assert snap["train/steps"] == 8.0
+        assert snap["perf/step_wall_ms"] == 20.0     # the mean
+        g = stats["gauges"]["perf/step_wall_ms"]
+        assert (g["min"], g["max"], g["spread"]) == (10.0, 30.0, 20.0)
+        assert g["values"] == [10.0, 30.0]
+        hm = merged.histogram("io/ms", [1.0, 10.0])
+        assert hm.count == 4 and hm.sum == 60.5
+        assert hm._min == 0.5 and hm._max == 50.0
+        assert stats["counters"]["train/steps"]["total"] == 8.0
+
+    def test_mismatched_bucket_bounds_skipped_loudly(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.histogram("h/ms", [1.0, 10.0]).observe(2.0)
+        b.histogram("h/ms", [1.0, 100.0]).observe(2.0)
+        merged, stats = merge_registry_dicts([a.to_dict(), b.to_dict()])
+        # first source wins; second is listed, never half-merged
+        assert merged.histogram("h/ms", [1.0, 10.0]).count == 1
+        assert stats["skipped_histograms"] == ["h/ms[source 1]"]
+
+    def test_percentile_after_merge_tracks_numpy_on_pooled_samples(self):
+        """The satellite contract: merging per-rank histograms then
+        asking for a percentile estimates the percentile of the POOLED
+        samples within the documented bucket-resolution bound
+        (relative error <= r - 1 on a log_buckets grid; min/max and
+        hence p0/p100 are exact)."""
+        lo, hi, n = 1e-1, 1e4, 40
+        bounds = log_buckets(lo, hi, n)
+        r = (hi / lo) ** (1.0 / (n - 1))
+        rng = np.random.RandomState(0)
+        pools = [rng.lognormal(mean=2.0, sigma=1.0, size=500)
+                 for _ in range(3)]
+        regs = []
+        for pool in pools:
+            reg = MetricsRegistry()
+            h = reg.histogram("lat/ms", bounds)
+            for v in pool:
+                h.observe(float(v))
+            regs.append(reg.to_dict())
+        merged, _ = merge_registry_dicts(regs)
+        hm = merged.histogram("lat/ms", bounds)
+        pooled = np.concatenate(pools)
+        assert hm.percentile(0) == pooled.min()
+        assert hm.percentile(100) == pooled.max()
+        for q in (10, 50, 90, 99):
+            want = float(np.percentile(pooled, q))
+            got = hm.percentile(q)
+            assert abs(got - want) <= (r - 1.0) * want, (q, got, want)
+
+
+# ---------------------------------------------------------------------------
+# the rank-side publisher
+# ---------------------------------------------------------------------------
+
+class TestFleetPublisher:
+    def test_atomic_snapshot_with_registry_and_step(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("train/steps").inc(4)
+        pub = FleetPublisher(str(tmp_path), rank=2, registry=reg)
+        path = pub.publish(4)
+        assert path == snapshot_path(str(tmp_path), 2)
+        assert not os.path.exists(path + ".tmp")  # replaced, not left
+        doc = json.load(open(path))
+        assert doc["schema"] == 1 and doc["rank"] == 2
+        assert doc["step"] == 4
+        assert doc["registry"]["counters"]["train/steps"] == 4.0
+
+    def test_reporter_hook_captures_health_state(self, tmp_path):
+        pub = FleetPublisher(str(tmp_path), rank=0,
+                             registry=MetricsRegistry())
+        pub(3, {"health/grads/nonfinite_count": 2.0,
+                "health/grads/abs_max": float("inf"),
+                "amp/overflow_count": 1.0,
+                "loss": 1.0})
+        doc = json.load(open(pub.path))
+        assert doc["health"] == {"health/grads/nonfinite_count": 2.0,
+                                 "health/grads/abs_max": "Infinity",
+                                 "amp/overflow_count": 1.0}
+        assert "loss" not in doc["health"]
+
+    def test_amp_overflow_alone_marks_the_rank_nonfinite(self, tmp_path):
+        """payload_nonfinite parity: a loss-scale overflow storm with no
+        health/* instrumentation must still reach the postmortem as a
+        non-finite rank (the culprit class health_nonfinite)."""
+        pub = FleetPublisher(str(tmp_path), rank=0,
+                             registry=MetricsRegistry())
+        pub(3, {"amp/overflow_count": 2.0})
+        os.makedirs(os.path.join(str(tmp_path), "logs"), exist_ok=True)
+        rep = PostmortemReport.collect(
+            str(tmp_path), round_index=0, world_size=1, cause="timeout",
+            returncodes={0: None}, heartbeat_ages={0: 0.1},
+            heartbeat_timeout_s=300.0)
+        assert rep.ranks[0].nonfinite is True
+        assert (rep.culprit_rank, rep.culprit_reason) == \
+            (0, "health_nonfinite")
+
+    def test_min_interval_throttles_but_force_overrides(self, tmp_path):
+        pub = FleetPublisher(str(tmp_path), rank=0,
+                             registry=MetricsRegistry(),
+                             min_interval_s=3600.0)
+        assert pub.publish(1) is not None
+        assert pub.publish(2) is None            # throttled
+        assert json.load(open(pub.path))["step"] == 1
+        assert pub.publish(2, force=True) is not None
+        assert json.load(open(pub.path))["step"] == 2
+
+    def test_step_wall_gauge_tracked_across_publishes(self, tmp_path):
+        reg = MetricsRegistry()
+        pub = FleetPublisher(str(tmp_path), rank=0, registry=reg)
+        pub.publish(1)
+        time.sleep(0.02)
+        pub.publish(3)  # 2 steps later
+        wall = reg.gauge("perf/step_wall_ms").value
+        assert wall > 0.0
+        doc = json.load(open(pub.path))
+        assert doc["registry"]["gauges"]["perf/step_wall_ms"] == wall
+
+
+# ---------------------------------------------------------------------------
+# the supervisor-side aggregator
+# ---------------------------------------------------------------------------
+
+def _rank_snapshot(run_dir, rank, step, steps_counter, wall_ms=None,
+                   health=None):
+    reg = MetricsRegistry()
+    reg.counter("train/steps").inc(steps_counter)
+    if wall_ms is not None:
+        reg.gauge("perf/step_wall_ms").set(wall_ms)
+    pub = FleetPublisher(run_dir, rank=rank, registry=reg)
+    if health:
+        pub(step, health)
+    else:
+        pub.publish(step)
+
+
+class TestFleetAggregator:
+    def test_straggler_signals_and_fleet_gauges(self, tmp_path):
+        run = str(tmp_path)
+        _rank_snapshot(run, 0, step=5, steps_counter=5, wall_ms=10.0)
+        _rank_snapshot(run, 1, step=3, steps_counter=3, wall_ms=40.0)
+        sup = MetricsRegistry()
+        sup.gauge("elastic/world_size").set(2)
+        agg = FleetAggregator(run, registry=sup)
+        view = agg.refresh()
+        assert view["ranks"] == [0, 1]
+        assert view["steps"] == {0: 5, 1: 3}
+        assert view["step_skew"] == 2 and view["slowest_rank"] == 1
+        assert view["step_wall_spread_ms"] == 30.0
+        snap = sup.snapshot()
+        assert snap["fleet/ranks"] == 2.0
+        assert snap["fleet/step_skew"] == 2.0
+        assert snap["fleet/slowest_rank"] == 1.0
+        assert snap["fleet/step_wall_spread_ms"] == 30.0
+
+    def test_step_tie_breaks_to_largest_wall(self, tmp_path):
+        run = str(tmp_path)
+        _rank_snapshot(run, 0, step=4, steps_counter=4, wall_ms=10.0)
+        _rank_snapshot(run, 1, step=4, steps_counter=4, wall_ms=50.0)
+        view = FleetAggregator(run, registry=MetricsRegistry()).view()
+        assert view["step_skew"] == 0 and view["slowest_rank"] == 1
+
+    def test_merged_registry_includes_supervisor_and_sums_ranks(
+            self, tmp_path):
+        run = str(tmp_path)
+        _rank_snapshot(run, 0, step=2, steps_counter=2)
+        _rank_snapshot(run, 1, step=2, steps_counter=2)
+        sup = MetricsRegistry()
+        sup.gauge("elastic/world_size").set(2)
+        sup.counter("elastic/restarts").inc()
+        merged = FleetAggregator(run, registry=sup).merged_registry()
+        snap = merged.snapshot()
+        assert snap["train/steps"] == 4.0
+        assert snap["elastic/world_size"] == 2.0
+        assert snap["elastic/restarts"] == 1.0
+        text = merged.render_prometheus()
+        assert "train_steps 4" in text
+        assert "elastic_world_size 2" in text
+
+    def test_scrape_is_one_merge_with_fresh_fleet_gauges(self, tmp_path):
+        """The /metrics fast path: scrape() returns the view and the
+        combined registry from ONE merge — with THIS scrape's fleet/*
+        values rendered (not the previous refresh's), the supervisor's
+        own metrics folded in, and the rank spread stats rank-only."""
+        run = str(tmp_path)
+        _rank_snapshot(run, 0, step=5, steps_counter=5, wall_ms=10.0)
+        _rank_snapshot(run, 1, step=3, steps_counter=3, wall_ms=40.0)
+        sup = MetricsRegistry()
+        sup.gauge("elastic/world_size").set(2)
+        agg = FleetAggregator(run, registry=sup)
+        doc, merged = agg.scrape()
+        assert doc["step_skew"] == 2
+        snap = merged.snapshot()
+        assert snap["train/steps"] == 8.0
+        assert snap["elastic/world_size"] == 2.0
+        assert snap["fleet/step_skew"] == 2.0       # this scrape's value
+        assert sup.snapshot()["fleet/step_skew"] == 2.0  # canonical copy
+        # spread stats stayed rank-only despite the supervisor doc
+        assert doc["gauges"]["perf/step_wall_ms"]["values"] == \
+            [10.0, 40.0]
+
+    def test_refresh_resets_straggler_gauges_when_fleet_empties(
+            self, tmp_path):
+        """The cleared-between-rounds invariant: after clear(), a
+        refresh over zero snapshots must RESET the skew/straggler
+        gauges (unset -> skipped), not let a dead gang's numbers read
+        as current next to fleet/ranks=0."""
+        run = str(tmp_path)
+        _rank_snapshot(run, 0, step=5, steps_counter=5, wall_ms=10.0)
+        _rank_snapshot(run, 1, step=3, steps_counter=3, wall_ms=40.0)
+        sup = MetricsRegistry()
+        agg = FleetAggregator(run, registry=sup)
+        agg.refresh()
+        assert sup.snapshot()["fleet/step_skew"] == 2.0
+        agg.clear()
+        agg.refresh()
+        snap = sup.snapshot()
+        assert snap["fleet/ranks"] == 0.0
+        for name in ("fleet/step_skew", "fleet/slowest_rank",
+                     "fleet/step_wall_spread_ms"):
+            assert name not in snap, name
+
+    def test_unreadable_snapshot_skipped_and_clear(self, tmp_path):
+        run = str(tmp_path)
+        _rank_snapshot(run, 0, step=1, steps_counter=1)
+        with open(snapshot_path(run, 1), "w") as f:
+            f.write("{torn")
+        agg = FleetAggregator(run, registry=MetricsRegistry())
+        assert sorted(agg.snapshots()) == [0]
+        agg.clear()
+        assert agg.snapshots() == {}
+        assert agg.view()["ranks"] == []  # empty fleet is not an error
+
+
+# ---------------------------------------------------------------------------
+# the /metrics endpoint
+# ---------------------------------------------------------------------------
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+def _assert_prometheus(text):
+    """Minimal text-exposition parse: every non-comment line is
+    ``name{labels}? value`` with a float-parsable value (NaN/+Inf/-Inf
+    are the accepted spellings)."""
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        assert name, line
+        float(value)
+
+
+class TestMetricsServer:
+    def test_serves_prometheus_and_fleet_json(self):
+        reg = MetricsRegistry()
+        reg.counter("train/steps").inc(2)
+        reg.gauge("health/grads/abs_max").set(float("nan"))
+        srv = MetricsServer(reg.render_prometheus,
+                            lambda: {"ranks": [0], "bad": float("inf")})
+        port = srv.start()
+        try:
+            status, text = _get(f"http://127.0.0.1:{port}/metrics")
+            assert status == 200
+            _assert_prometheus(text)
+            assert "train_steps 2" in text
+            assert "health_grads_abs_max NaN" in text
+            status, body = _get(f"http://127.0.0.1:{port}/fleet")
+            assert status == 200
+            doc = json.loads(body)  # strict JSON despite the inf
+            assert doc["ranks"] == [0] and doc["bad"] == "Infinity"
+        finally:
+            srv.close()
+
+    def test_unknown_route_404_render_error_500(self):
+        def boom():
+            raise RuntimeError("render failed")
+
+        srv = MetricsServer(boom)
+        port = srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(f"http://127.0.0.1:{port}/nope")
+            assert e.value.code == 404
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(f"http://127.0.0.1:{port}/metrics")
+            assert e.value.code == 500
+            # no /fleet renderer -> 404, not a crash
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(f"http://127.0.0.1:{port}/fleet")
+            assert e.value.code == 404
+        finally:
+            srv.close()
+
+    def test_close_is_deterministic_and_reusable(self):
+        reg = MetricsRegistry()
+        srv = MetricsServer(reg.render_prometheus)
+        port = srv.start()
+        srv.close()
+        srv.close()  # idempotent
+        with pytest.raises(OSError):
+            _get(f"http://127.0.0.1:{port}/metrics", timeout=0.5)
+
+
+# ---------------------------------------------------------------------------
+# postmortems
+# ---------------------------------------------------------------------------
+
+def _seed_run_dir(tmp_path, world=2):
+    run = str(tmp_path)
+    os.makedirs(os.path.join(run, "logs"), exist_ok=True)
+    for r in range(world):
+        with open(os.path.join(run, "logs",
+                               f"round0_rank{r}.log"), "w") as f:
+            f.write(f"rank {r} log line\n")
+    return run
+
+
+class TestPostmortem:
+    def test_dead_heartbeat_outranks_everything(self, tmp_path):
+        run = _seed_run_dir(tmp_path)
+        # rank 0 stalled AND nonfinite; rank 1 died -> rank 1 wins
+        _rank_snapshot(run, 0, step=3, steps_counter=3,
+                       health={"health/grads/nonfinite_count": 2.0})
+        rep = PostmortemReport.collect(
+            run, round_index=0, world_size=2, cause="exit",
+            returncodes={0: None, 1: -9},
+            heartbeat_ages={0: 0.1, 1: 4.0},
+            stalled_ranks=[0], heartbeat_timeout_s=300.0)
+        assert rep.culprit_rank == 1
+        assert rep.culprit_reason == "heartbeat_dead"
+
+    def test_silent_past_budget_is_dead_even_without_exit(self, tmp_path):
+        run = _seed_run_dir(tmp_path)
+        rep = PostmortemReport.collect(
+            run, round_index=0, world_size=2, cause="heartbeat",
+            returncodes={0: None, 1: None},
+            heartbeat_ages={0: 0.5, 1: 99.0},
+            heartbeat_timeout_s=10.0)
+        assert rep.culprit_rank == 1
+        assert rep.culprit_reason == "heartbeat_dead"
+
+    def test_stalled_step_second_nonfinite_third(self, tmp_path):
+        run = _seed_run_dir(tmp_path)
+        _rank_snapshot(run, 0, step=3, steps_counter=3,
+                       health={"health/grads/nonfinite_count": 1.0})
+        rep = PostmortemReport.collect(
+            run, round_index=0, world_size=2, cause="stall",
+            returncodes={0: None, 1: None},
+            heartbeat_ages={0: 0.1, 1: 0.1},
+            stalled_ranks=[1], heartbeat_timeout_s=300.0)
+        assert (rep.culprit_rank, rep.culprit_reason) == \
+            (1, "stalled_step")
+        rep2 = PostmortemReport.collect(
+            run, round_index=0, world_size=2, cause="timeout",
+            returncodes={0: None, 1: None},
+            heartbeat_ages={0: 0.1, 1: 0.1},
+            heartbeat_timeout_s=300.0)
+        assert (rep2.culprit_rank, rep2.culprit_reason) == \
+            (0, "health_nonfinite")
+
+    def test_no_signal_is_unknown_not_a_scapegoat(self, tmp_path):
+        run = _seed_run_dir(tmp_path)
+        rep = PostmortemReport.collect(
+            run, round_index=0, world_size=2, cause="timeout",
+            returncodes={0: None, 1: None},
+            heartbeat_ages={0: 0.1, 1: 0.1},
+            heartbeat_timeout_s=300.0)
+        assert rep.culprit_rank is None
+        assert rep.culprit_reason == "unknown"
+
+    def test_artifacts_strict_json_plus_markdown(self, tmp_path):
+        run = _seed_run_dir(tmp_path)
+        _rank_snapshot(run, 1, step=2, steps_counter=2,
+                       health={"health/grads/abs_max": float("inf"),
+                               "health/grads/nonfinite_count": 3.0})
+        rep = PostmortemReport.collect(
+            run, round_index=0, world_size=2, cause="exit",
+            returncodes={0: None, 1: -9},
+            heartbeat_ages={0: 0.2, 1: 5.0},
+            heartbeat_timeout_s=300.0)
+        json_path, md_path = rep.write(os.path.join(run, "postmortem"))
+        doc = json.load(open(json_path))  # strict parse (jq contract)
+        assert doc["culprit_rank"] == 1
+        assert doc["culprit_reason"] == "heartbeat_dead"
+        ranks = {r["rank"]: r for r in doc["ranks"]}
+        assert ranks[1]["returncode"] == -9
+        assert ranks[1]["nonfinite"] is True
+        assert ranks[1]["snapshot_step"] == 2
+        assert "rank 1 log line" in ranks[1]["log_tail"]
+        md = open(md_path).read()
+        assert "rank 1" in md and "heartbeat_dead" in md
+        assert "| 1 | -9 |" in md
+
+
+# ---------------------------------------------------------------------------
+# trace timebase: epoch offset + two-rank merge
+# ---------------------------------------------------------------------------
+
+class TestTraceTimebase:
+    def test_epoch_offset_translates_perf_counter_to_wall(self):
+        off = trace_mod.epoch_offset()
+        assert abs((time.perf_counter() + off) - time.time()) < 0.5
+
+    def test_sink_and_request_exporter_stamp_metadata(self, tmp_path):
+        path = tmp_path / "trace.json"
+        sink = ChromeTraceSink(str(path), pid=0)
+        sink.emit(1, {"loss": 1.0},
+                  [trace_mod.Span("step", 0.0, 1.0)])
+        sink.close()
+        doc = json.loads(path.read_text())
+        assert "epoch_offset_s" in doc["metadata"]
+        assert doc["metadata"]["clock"] == "perf_counter"
+        from apex_tpu.observability.reqtrace import chrome_request_trace
+        doc2 = chrome_request_trace([])
+        assert "epoch_offset_s" in doc2["metadata"]
+
+    def test_two_rank_merge_aligns_process_local_timebases(self):
+        """Rank A's clock started 100s ago, rank B's 5s ago; an event at
+        A's perf t=2 happened BEFORE one at B's perf t=1 in wall time.
+        Raw ts ordering says otherwise; the merged (epoch) ordering must
+        get it right."""
+        mk = lambda name, t, off, pid: {
+            "traceEvents": trace_mod.chrome_trace_events(
+                [trace_mod.Span(name, t, t + 0.5)], pid=pid),
+            "metadata": {"clock": "perf_counter", "epoch_offset_s": off}}
+        base = 1_700_000_000.0
+        doc_a = mk("a", 2.0, base + 100.0, pid=0)   # epoch 102
+        doc_b = mk("b", 1.0, base + 200.0, pid=1)   # epoch 201
+        merged = trace_mod.merge_chrome_traces([doc_a, doc_b])
+        names = [e["name"] for e in merged["traceEvents"]]
+        assert names == ["a", "b"]
+        ts = {e["name"]: e["ts"] for e in merged["traceEvents"]}
+        assert ts["a"] == pytest.approx((base + 102.0) * 1e6)
+        assert ts["b"] == pytest.approx((base + 201.0) * 1e6)
+        # pids survive: the per-rank lanes stay separable
+        assert {e["pid"] for e in merged["traceEvents"]} == {0, 1}
+        assert merged["metadata"]["clock"] == "epoch"
+
+    def test_merge_refuses_unstamped_documents(self):
+        with pytest.raises(ValueError, match="epoch_offset_s"):
+            trace_mod.merge_chrome_traces([{"traceEvents": []}])
+
+    def test_colliding_default_pids_are_separated(self):
+        """Both exporters default to pid=0, so two ranks' files collide
+        — the merge must re-stamp per-document pids so the ranks stay
+        separable lanes instead of interleaving in one."""
+        mk = lambda name: {
+            "traceEvents": trace_mod.chrome_trace_events(
+                [trace_mod.Span(name, 1.0, 2.0)]),   # default pid=0
+            "metadata": {"epoch_offset_s": 10.0}}
+        merged = trace_mod.merge_chrome_traces([mk("a"), mk("b")])
+        by_name = {e["name"]: e["pid"] for e in merged["traceEvents"]}
+        assert by_name["a"] != by_name["b"]
+        # collision-free inputs keep their pids verbatim (pinned above
+        # in test_two_rank_merge_aligns_process_local_timebases)
+
+
+# ---------------------------------------------------------------------------
+# the host-side-only contract: publisher on vs off, byte-identical step
+# ---------------------------------------------------------------------------
+
+class TestPublisherZeroCost:
+    def test_step_program_byte_identical_with_publisher_on(self,
+                                                           tmp_path):
+        """The acceptance contract, PR 12 style: running the elastic
+        loop with a FleetPublisher attached changes NOTHING on the
+        device — the compiled step program is byte-identical, and the
+        losses match an unpublished run exactly."""
+        import jax
+        from test_elastic import ToyTrainer, _toy_data
+
+        from apex_tpu.elastic import ElasticRunner
+
+        def run(ckdir, fleet_dir):
+            trainer = ToyTrainer()
+            step_fn = trainer.jit_train_step()
+            state = trainer.init_state(jax.random.PRNGKey(0))
+            batch = next(_toy_data())
+            compiled = step_fn.lower(*state, *batch).compile()
+            reg = MetricsRegistry()  # shared runner<->publisher, the
+            # production wiring (both default to get_registry())
+            publisher = (FleetPublisher(str(fleet_dir), rank=0,
+                                        registry=reg)
+                         if fleet_dir is not None else None)
+            runner = ElasticRunner(
+                trainer, _toy_data(), str(ckdir), save_interval=10,
+                exit_on_preempt=False, registry=reg,
+                publisher=publisher)
+            res = runner.fit(3, key=jax.random.PRNGKey(0))
+            return compiled.as_text(), res, publisher
+
+        text_off, res_off, _ = run(tmp_path / "off", None)
+        text_on, res_on, pub = run(tmp_path / "on", tmp_path / "fleet")
+        assert text_on == text_off
+        assert res_on.loss == res_off.loss and res_on.step == res_off.step
+        # and the publisher DID run: final forced snapshot at step 3
+        doc = json.load(open(pub.path))
+        assert doc["step"] == 3
+        assert doc["registry"]["counters"]["train/steps"] == 3.0

@@ -631,6 +631,7 @@ class TestLocalLauncher:
         assert reg.snapshot()["elastic/world_size"] == 1
 
     def test_heartbeat_timeout_declares_a_hung_rank(self, tmp_path):
+        import json
         reg = MetricsRegistry()
         body = """\
             time.sleep(600)  # alive but never beats
@@ -643,6 +644,12 @@ class TestLocalLauncher:
         assert not report.succeeded
         assert report.rounds[0].cause == "heartbeat"
         assert reg.snapshot()["elastic/heartbeat_age_s"] > 0.6
+        # a rank wedged BEFORE its first beat is still nameable: the
+        # postmortem ages it from round start (the hang detector's own
+        # clock) instead of dissolving into "unknown"
+        pm = json.load(open(report.rounds[0].postmortem))
+        assert pm["culprit_rank"] == 0
+        assert pm["culprit_reason"] == "heartbeat_dead"
 
     def test_worker_heartbeats_keep_the_round_alive(self, tmp_path):
         """A worker alive LONGER than the heartbeat budget survives as
@@ -694,6 +701,183 @@ class TestLocalLauncher:
                            min_processes=3)
 
 
+class TestProgressStall:
+    """Satellite: liveness is not progress — a rank whose heartbeat
+    mtime keeps moving but whose reported step never advances must be
+    flagged within the round budget."""
+
+    def _launcher(self, tmp_path, argv, **kw):
+        kw.setdefault("num_processes", 1)
+        kw.setdefault("min_processes", 1)
+        kw.setdefault("max_restarts", 0)
+        kw.setdefault("grace_s", 1.0)
+        kw.setdefault("registry", MetricsRegistry())
+        return LocalLauncher(argv, run_dir=str(tmp_path / "run"), **kw)
+
+    def test_beating_but_stuck_rank_is_declared_stalled(self, tmp_path):
+        """Constant-step heartbeats forever: the worker is perfectly
+        alive (old detector: healthy forever) but makes no progress —
+        cause "stall" within the heartbeat budget, and the postmortem
+        names it with reason stalled_step."""
+        body = """\
+            hb = os.path.join(RUN, "hb", f"rank_{RANK}")
+            os.makedirs(os.path.dirname(hb), exist_ok=True)
+            for _ in range(200):
+                with open(hb + ".tmp", "w") as f:
+                    f.write(f"7 {time.time()}\\n")  # step NEVER moves
+                os.replace(hb + ".tmp", hb)
+                time.sleep(0.1)
+        """
+        launcher = self._launcher(tmp_path, _stub_worker(body),
+                                  heartbeat_timeout_s=0.8)
+        report = launcher.run()
+        assert not report.succeeded
+        assert report.rounds[0].cause == "stall"
+        import json
+        pm = json.load(open(report.rounds[0].postmortem))
+        assert pm["culprit_rank"] == 0
+        assert pm["culprit_reason"] == "stalled_step"
+        assert pm["ranks"][0]["stalled"] is True
+
+    # NOTE: the advancing-step twin (a worker alive LONGER than the
+    # budget whose step keeps moving must survive) is
+    # TestLocalLauncher.test_worker_heartbeats_keep_the_round_alive
+    # above — its stub advances the step every beat, so it now pins the
+    # progress detector's negative case too.
+
+    def test_step_free_heartbeats_are_exempt(self, tmp_path):
+        """A writer speaking only the mtime protocol (no parseable
+        step) must not be declared stalled — liveness detection is all
+        the supervisor can honestly do for it."""
+        body = """\
+            hb = os.path.join(RUN, "hb", f"rank_{RANK}")
+            os.makedirs(os.path.dirname(hb), exist_ok=True)
+            for _ in range(10):
+                with open(hb + ".tmp", "w") as f:
+                    f.write("alive\\n")  # no step field
+                os.replace(hb + ".tmp", hb)
+                time.sleep(0.2)
+            sys.exit(0)
+        """
+        launcher = self._launcher(tmp_path, _stub_worker(body),
+                                  heartbeat_timeout_s=0.8)
+        assert launcher.run().succeeded
+
+
+class TestLauncherPostmortem:
+    def test_failed_round_writes_artifacts_naming_the_dead_rank(
+            self, tmp_path):
+        """The kill-rank picture in miniature: rank 1 dies on its own,
+        rank 0 hangs (as a peer of a dead jax rank would) and gets the
+        SUPERVISOR's kill at teardown — the postmortem must blame rank
+        1 (pre-teardown exit code), not the framed survivor."""
+        import json
+        body = """\
+            if WORLD == 2 and RANK == 1:
+                sys.exit(9)
+            if WORLD == 2:
+                import signal
+                signal.signal(signal.SIGTERM, signal.SIG_IGN)
+                time.sleep(600)
+            sys.exit(0)
+        """
+        launcher = LocalLauncher(
+            _stub_worker(body), num_processes=2, min_processes=1,
+            max_restarts=0, grace_s=1.0, restart_backoff_s=0.05,
+            run_dir=str(tmp_path / "run"), registry=MetricsRegistry())
+        report = launcher.run()
+        assert report.succeeded and report.shrinks == 1
+        first = report.rounds[0]
+        assert first.cause == "exit" and first.postmortem
+        pm = json.load(open(first.postmortem))
+        assert pm["culprit_rank"] == 1
+        assert pm["culprit_reason"] == "heartbeat_dead"
+        ranks = {r["rank"]: r for r in pm["ranks"]}
+        assert ranks[1]["returncode"] == 9
+        # the survivor was alive pre-teardown: no exit code pinned on it
+        assert ranks[0]["returncode"] is None
+        # markdown twin next to the JSON
+        assert os.path.exists(first.postmortem[:-5] + ".md")
+        # the successful world-1 round writes none
+        assert report.rounds[1].cause == "ok"
+        assert report.rounds[1].postmortem is None
+
+
+class TestLauncherMetricsEndpoint:
+    def test_live_scrape_serves_merged_registry(self, tmp_path):
+        """metrics_port=0: while the gang runs, /metrics serves the
+        supervisor's elastic/ metrics MERGED with every rank's
+        published snapshot (counters summed), and /fleet returns the
+        raw merged JSON."""
+        import json
+        import threading
+        import time
+        import urllib.request
+
+        body = """\
+            from apex_tpu.observability.fleet import FleetPublisher
+            from apex_tpu.observability.registry import MetricsRegistry
+            reg = MetricsRegistry()
+            reg.counter("train/steps").inc(1)
+            hb = os.path.join(RUN, "hb", f"rank_{RANK}")
+            os.makedirs(os.path.dirname(hb), exist_ok=True)
+            with open(hb + ".tmp", "w") as f:
+                f.write(f"1 {time.time()}\\n")
+            os.replace(hb + ".tmp", hb)
+            FleetPublisher(RUN, rank=RANK, registry=reg).publish(
+                1, force=True)
+            time.sleep(2.0)
+            sys.exit(0)
+        """
+        src = _stub_worker(body)
+        src[-1] = f"import sys; sys.path.insert(0, {os.getcwd()!r})\n" \
+            + src[-1]
+        launcher = LocalLauncher(
+            src, num_processes=2, min_processes=2, max_restarts=0,
+            grace_s=1.0, heartbeat_timeout_s=60.0,
+            run_dir=str(tmp_path / "run"), registry=MetricsRegistry(),
+            metrics_port=0)
+        box = {}
+        th = threading.Thread(
+            target=lambda: box.update(report=launcher.run()))
+        th.start()
+        try:
+            scrape = fleet_doc = None
+            deadline = time.monotonic() + 30.0
+            while th.is_alive() and time.monotonic() < deadline:
+                port = launcher.bound_metrics_port
+                if port is not None:
+                    try:
+                        text = urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/metrics",
+                            timeout=2).read().decode()
+                        if ("train_steps 2" in text
+                                and "elastic_world_size 2" in text):
+                            scrape = text
+                            fleet_doc = json.loads(
+                                urllib.request.urlopen(
+                                    f"http://127.0.0.1:{port}/fleet",
+                                    timeout=2).read())
+                            break
+                    except OSError:
+                        pass
+                time.sleep(0.1)
+        finally:
+            th.join()
+        assert box["report"].succeeded
+        assert scrape is not None, "merged families never appeared"
+        # counters SUMMED across both ranks, supervisor metrics present
+        assert "train_steps 2" in scrape
+        assert "fleet_ranks 2" in scrape
+        assert fleet_doc["counters"]["train/steps"]["total"] == 2.0
+        assert fleet_doc["step_skew"] == 0
+        # the server is gone once run() returned
+        with pytest.raises(OSError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{launcher.bound_metrics_port}"
+                f"/metrics", timeout=0.5)
+
+
 class TestHeartbeat:
     def test_supervisor_age_is_monotonic_not_wallclock(self, tmp_path):
         """A wall-clock step must not fake staleness: the supervisor
@@ -731,6 +915,30 @@ class TestHeartbeat:
         hb = Heartbeat(str(tmp_path))
         hb.beat(1)
         assert Heartbeat.last_step(str(tmp_path), 3) == 1
+
+    def test_beat_writes_atomic_json_payload(self, tmp_path):
+        """Satellite: beat() grows a JSON payload (schema version +
+        completed step) next to the mtime touch; last_step prefers it,
+        clear removes it with the rest."""
+        import json
+        hb = Heartbeat(str(tmp_path), rank=0)
+        hb.beat(12)
+        doc = json.load(open(hb.path + ".json"))
+        assert doc["schema"] == Heartbeat.SCHEMA
+        assert doc["step"] == 12 and doc["time"] > 0
+        assert not os.path.exists(hb.path + ".json.tmp")
+        assert Heartbeat.last_step(str(tmp_path), 0) == 12
+        Heartbeat.clear(str(tmp_path))
+        assert not os.path.exists(hb.path + ".json")
+
+    def test_last_step_falls_back_to_text_protocol(self, tmp_path):
+        """External writers that only speak the legacy text format
+        (the stub workers above) stay decodable."""
+        path = os.path.join(str(tmp_path), "hb", "rank_4")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write("9 1690000000.0\n")
+        assert Heartbeat.last_step(str(tmp_path), 4) == 9
 
 
 # ---------------------------------------------------------------------------
