@@ -31,11 +31,29 @@ preemption and filesystem faults are a tested path, not a hope:
   rendezvous coordinator — killing it tests the coordinator, not a
   worker).
 
+**Serving faults** (consumed by
+:class:`~apex_tpu.serving.scheduler.SlotScheduler` — steps here are
+DECODE steps, 1-based, counted by the scheduler):
+
+- ``poison_logits={step: slot}`` — at decode step ``step``, inject NaN
+  into ``slot``'s sampling-path logits (an array-argument add inside the
+  already-compiled quarantine decode program — zero extra compiles).
+  The poison-slot quarantine must retire exactly that slot with
+  ``finish_reason="poisoned"`` and leave every other stream untouched.
+- ``slow_decode_s=t`` — stretch every decode step by ``t`` seconds
+  (host-side sleep), deterministically inflating TPOT/e2e so deadline
+  expiry and SLO-brownout paths fire on schedule.
+- ``flood={step: n}`` — the overload schedule: the loop driving the
+  scheduler submits ``n`` extra requests right before decode step
+  ``step`` (the scheduler cannot fabricate requests, so this hook is
+  read by the driver — see :meth:`flood_n`).
+
 Plans are *explicitly seeded* and fully serializable: :meth:`sample`
-derives one from an integer seed via ``numpy.random.RandomState`` (no
-wall-clock entropy anywhere), and :meth:`to_json` / :meth:`from_json`
-carry a plan across a process boundary (the kill-and-resume subprocess
-tests hand the child its plan on the command line).
+(training) and :meth:`sample_serving` (serving chaos) derive one from an
+integer seed via ``numpy.random.RandomState`` (no wall-clock entropy
+anywhere), and :meth:`to_json` / :meth:`from_json` carry a plan across a
+process boundary (the kill-and-resume subprocess tests hand the child
+its plan on the command line).
 """
 
 from __future__ import annotations
@@ -62,6 +80,10 @@ class FaultPlan:
     tear_after_step: Optional[int] = None
     slow_save_s: float = 0.0
     kill_process: Dict[int, int] = dataclasses.field(default_factory=dict)
+    # serving faults (decode-step keyed, 1-based; see module docstring)
+    poison_logits: Dict[int, int] = dataclasses.field(default_factory=dict)
+    slow_decode_s: float = 0.0
+    flood: Dict[int, int] = dataclasses.field(default_factory=dict)
     seed: Optional[int] = None  # provenance when built via sample()
 
     # -- injection hooks --------------------------------------------------
@@ -101,6 +123,28 @@ class FaultPlan:
             if os.path.exists(marker):
                 os.remove(marker)
 
+    # -- serving hooks ----------------------------------------------------
+    def before_decode(self, step: int) -> None:
+        """:class:`~apex_tpu.serving.scheduler.SlotScheduler` hook,
+        called right before decode step ``step`` dispatches: applies the
+        scripted ``slow_decode_s`` stretch."""
+        if self.slow_decode_s > 0.0:
+            time.sleep(self.slow_decode_s)
+
+    def poison_slot(self, step: int) -> Optional[int]:
+        """The slot whose sampling-path logits the scheduler must NaN at
+        decode step ``step`` (None: no injection this step). Injection
+        requires the engine's quarantine check to be compiled in; the
+        scheduler refuses a poison plan on a quarantine-off engine
+        instead of silently dropping the fault."""
+        return self.poison_logits.get(step)
+
+    def flood_n(self, step: int) -> int:
+        """How many extra requests the DRIVER should submit right before
+        decode step ``step`` (the scheduler cannot fabricate requests;
+        chaos tests and the dryrun leg read this)."""
+        return int(self.flood.get(step, 0))
+
     # -- construction / transport ----------------------------------------
     @classmethod
     def sample(cls, seed: int, total_steps: int, *,
@@ -134,18 +178,44 @@ class FaultPlan:
             plan.tear_after_step = k
         return plan
 
+    @classmethod
+    def sample_serving(cls, seed: int, total_steps: int, *,
+                       max_slots: int, flood_n: int = 4,
+                       slow_decode_s: float = 0.0) -> "FaultPlan":
+        """Derive a serving chaos plan deterministically from ``seed``:
+        one flood of ``flood_n`` extra requests early in the run (while
+        slots are still busy), one poisoned slot at a later decode step,
+        and an optional per-step decode stretch — the flood + poison +
+        slow-step combination the chaos test drives in one run.
+
+        The poison step is drawn from the second half of
+        ``[1, total_steps)`` so the flood has already saturated every
+        slot (a poison aimed at an idle slot injects nothing); the slot
+        is uniform over ``[0, max_slots)``.
+        """
+        if total_steps < 4:
+            raise ValueError("total_steps must be >= 4 to place "
+                             "flood and poison faults")
+        if max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        rs = np.random.RandomState(seed)
+        flood_step = int(rs.randint(1, max(2, total_steps // 4)))
+        poison_step = int(rs.randint(total_steps // 2, total_steps))
+        return cls(flood={flood_step: int(flood_n)},
+                   poison_logits={poison_step: int(rs.randint(max_slots))},
+                   slow_decode_s=float(slow_decode_s), seed=int(seed))
+
     def to_json(self) -> str:
         d = dataclasses.asdict(self)
-        d["save_errors"] = {str(k): v for k, v in self.save_errors.items()}
-        d["kill_process"] = {str(k): v
-                             for k, v in self.kill_process.items()}
+        for key in ("save_errors", "kill_process", "poison_logits",
+                    "flood"):
+            d[key] = {str(k): v for k, v in getattr(self, key).items()}
         return json.dumps(d)
 
     @classmethod
     def from_json(cls, text: str) -> "FaultPlan":
         d = json.loads(text)
-        d["save_errors"] = {int(k): int(v)
-                            for k, v in d.get("save_errors", {}).items()}
-        d["kill_process"] = {int(k): int(v)
-                             for k, v in d.get("kill_process", {}).items()}
+        for key in ("save_errors", "kill_process", "poison_logits",
+                    "flood"):
+            d[key] = {int(k): int(v) for k, v in d.get(key, {}).items()}
         return cls(**d)

@@ -50,10 +50,19 @@ from apex_tpu.observability.registry import get_registry
 from apex_tpu.observability.reqtrace import RequestRecord, RequestTrace
 
 __all__ = ["SLOTarget", "SLOTracker", "SLOViolationError",
-           "LATENCY_METRICS", "ON_VIOLATION"]
+           "LATENCY_METRICS", "ON_VIOLATION", "FAILED_REASONS"]
 
 LATENCY_METRICS = ("queue_wait_ms", "ttft_ms", "tpot_ms", "e2e_ms")
 ON_VIOLATION = ("skip", "dump", "raise")
+
+# finish reasons that are SERVER-side failures: such a retirement counts
+# against goodput unconditionally, whatever its (often absent) latency
+# fields say. Without this, a request expired while QUEUED — ttft/tpot
+# never measured, e2e tiny — would sail past every latency target and
+# read as served-well at exactly the moment the server is shedding its
+# queue; "cancelled" stays metrics-based (a user disconnect is not the
+# server failing).
+FAILED_REASONS = ("expired", "poisoned", "error")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -160,8 +169,11 @@ class SLOTracker:
         """Ingest one retired request: window updates + ``slo/*`` gauges,
         O(targets) per call (counters maintained incrementally). A
         latency a request does not define (``tpot_ms`` on a one-token
-        request) neither counts for nor against its targets."""
-        good = True
+        request) neither counts for nor against its targets — but a
+        server-side failure retirement (:data:`FAILED_REASONS`) is
+        counted against goodput unconditionally, defined latencies or
+        not."""
+        good = record.finish_reason not in FAILED_REASONS
         for i, target in enumerate(self.targets):
             v = getattr(record, target.metric)
             if v is None:
@@ -184,7 +196,9 @@ class SLOTracker:
 
     def goodput(self) -> float:
         """Fraction of windowed requests that met EVERY target's
-        threshold (NaN before the first retirement)."""
+        threshold AND did not retire by a server-side failure
+        (:data:`FAILED_REASONS` — expired/poisoned/error). NaN before
+        the first retirement."""
         if not self._good:
             return float("nan")
         return self._good_count / len(self._good)
@@ -198,6 +212,15 @@ class SLOTracker:
         if not self._vals[i]:
             return float("nan")
         return (self._over[i] / len(self._vals[i])) / target.error_budget
+
+    def max_burn_rate(self) -> float:
+        """Worst burn rate across targets (NaN with no samples anywhere)
+        — the single number the serving brownout policy
+        (:class:`~apex_tpu.serving.resilience.BrownoutPolicy`) and the
+        ``slo/burn_rate`` gauge summarize the tracker to."""
+        burns = [b for t in self.targets
+                 if (b := self.burn_rate(t)) == b]
+        return max(burns) if burns else float("nan")
 
     def window_percentile(self, target: SLOTarget) -> float:
         """The target metric's p-``quantile`` over the rolling window —
@@ -222,10 +245,9 @@ class SLOTracker:
     def _update_gauges(self) -> None:
         reg = self._reg
         reg.gauge("slo/goodput").set(self.goodput())
-        burns = [self.burn_rate(t) for t in self.targets]
-        burns = [b for b in burns if b == b]
-        if burns:
-            reg.gauge("slo/burn_rate").set(max(burns))
+        burn = self.max_burn_rate()
+        if burn == burn:  # skip the NaN empty-window readout
+            reg.gauge("slo/burn_rate").set(burn)
         reg.gauge("slo/violating").set(
             1.0 if self.violating_targets() else 0.0)
         reg.gauge("slo/window_requests").set(float(len(self._good)))

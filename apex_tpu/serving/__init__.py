@@ -12,6 +12,10 @@ and SLO goodput tracking) lives in
 :mod:`apex_tpu.observability.reqtrace` /
 :mod:`~apex_tpu.observability.slo` and is re-exported here for
 wiring convenience (``SlotScheduler(engine, trace=..., slo=...)``).
+The resilience layer (typed admission rejections, deadlines,
+poison-slot quarantine, graceful drain + zero-recompile hot weight
+swap, SLO brownout — docs/SERVING.md "Resilience") lives in
+:mod:`~apex_tpu.serving.resilience` plus scheduler/engine wiring.
 """
 
 from apex_tpu.observability.reqtrace import (RequestRecord, RequestTrace,
@@ -20,10 +24,16 @@ from apex_tpu.observability.slo import (SLOTarget, SLOTracker,
                                         SLOViolationError)
 from apex_tpu.serving.cache import KVCache, cache_bytes_per_slot
 from apex_tpu.serving.engine import ServingEngine
+from apex_tpu.serving.resilience import (REJECTION_REASONS,
+                                         BrownoutPolicy,
+                                         CheckpointWatcher, Rejection,
+                                         watch_checkpoints)
 from apex_tpu.serving.sampling import sample_tokens
 from apex_tpu.serving.scheduler import Completion, Request, SlotScheduler
 
 __all__ = ["KVCache", "cache_bytes_per_slot", "ServingEngine",
            "sample_tokens", "Completion", "Request", "SlotScheduler",
            "RequestRecord", "RequestTrace", "chrome_request_trace",
-           "SLOTarget", "SLOTracker", "SLOViolationError"]
+           "SLOTarget", "SLOTracker", "SLOViolationError",
+           "Rejection", "REJECTION_REASONS", "BrownoutPolicy",
+           "CheckpointWatcher", "watch_checkpoints"]

@@ -63,11 +63,23 @@ class ServingEngine:
       cache_dtype: ``jnp.bfloat16`` (default) or ``jnp.int8`` (quantized
         cache with per-(position, head) scales).
       top_k: static top-k sampling cutoff (0 = full vocab).
+      quarantine: compile the poison-slot quarantine check into the
+        decode program — one per-slot ``isfinite`` reduction over the
+        sampling-path logits (fused into the head matmul's consumers,
+        no extra memory pass) plus a ``(max_seqs,)`` poison-injection
+        array argument (NaN for a slot poisons its logits — the
+        deterministic :class:`~apex_tpu.elastic.faults.FaultPlan`
+        injection path, zero extra compiles). After each
+        :meth:`decode`, :attr:`last_finite` carries the per-slot flags
+        the scheduler's quarantine reads. Default off — the decode
+        program is byte-identical to a quarantine-free engine's (the
+        PR 3 zero-cost idiom, asserted in ``tests/test_resilience.py``).
     """
 
     def __init__(self, model, params, *, max_seqs: int, max_len: int,
                  prefill_len: int, cache_dtype=jnp.bfloat16,
-                 top_k: int = 0, rng_seed: int = 0):
+                 top_k: int = 0, rng_seed: int = 0,
+                 quarantine: bool = False):
         model._require_cacheable()
         cfg = model.cfg
         if max_len > cfg.max_position_embeddings:
@@ -83,6 +95,9 @@ class ServingEngine:
         self.max_len = int(max_len)
         self.prefill_len = int(prefill_len)
         self.top_k = int(top_k)
+        self.quarantine = bool(quarantine)
+        self.last_finite: Optional[np.ndarray] = None
+        self.swaps = 0
         self.cache = KVCache.create(
             cfg.num_layers, max_seqs, cfg.num_attention_heads, max_len,
             cfg.head_dim, dtype=cache_dtype)
@@ -100,13 +115,37 @@ class ServingEngine:
                                     self.top_k)[0]
             return cache, tok
 
-        def decode_step(params, cache, tokens, temperature, active, rng):
-            with jax.named_scope("serve_decode"):
-                logits, cache = model.forward(params, tokens[:, None],
-                                              kv_cache=cache,
-                                              active=active)
-                toks = sample_tokens(logits, rng, temperature, self.top_k)
-            return cache, toks
+        if self.quarantine:
+            # the quarantine variant: one extra (S,) array argument
+            # (``poison``, normally zeros — adding NaN to a slot's row is
+            # the deterministic fault-injection path) and one extra
+            # per-slot output (``finite``). Both ride the SAME compiled
+            # program forever — injecting or clearing poison never
+            # retraces. The finite reduction runs on the post-injection
+            # sampling-path logits, so a NaN from ANY upstream source
+            # (poisoned cache, bad weights, the injection arg) flags the
+            # slot the very step it first reaches sampling.
+            def decode_step(params, cache, tokens, temperature, active,
+                            rng, poison):
+                with jax.named_scope("serve_decode"):
+                    logits, cache = model.forward(params, tokens[:, None],
+                                                  kv_cache=cache,
+                                                  active=active)
+                    logits = logits + poison[:, None]
+                    finite = jnp.all(jnp.isfinite(logits), axis=-1)
+                    toks = sample_tokens(logits, rng, temperature,
+                                         self.top_k)
+                return cache, toks, finite
+        else:
+            def decode_step(params, cache, tokens, temperature, active,
+                            rng):
+                with jax.named_scope("serve_decode"):
+                    logits, cache = model.forward(params, tokens[:, None],
+                                                  kv_cache=cache,
+                                                  active=active)
+                    toks = sample_tokens(logits, rng, temperature,
+                                         self.top_k)
+                return cache, toks
 
         key = jax.random.PRNGKey(rng_seed)
         self._key, _ = jax.random.split(key)  # also warms split's compile
@@ -119,11 +158,14 @@ class ServingEngine:
                 params, self.cache, ex_tokens, ex_scalar, ex_scalar,
                 ex_temp, self._key)
         self.prefill_compiled = self.prefill_traced.lower().compile()
+        self._zero_poison = jnp.zeros((S,), jnp.float32)
+        decode_args = (params, self.cache, jnp.zeros((S,), jnp.int32),
+                       jnp.zeros((S,), jnp.float32),
+                       jnp.ones((S,), jnp.bool_), self._key)
+        if self.quarantine:
+            decode_args += (self._zero_poison,)
         self.decode_traced = jax.jit(
-            decode_step, donate_argnums=(1,)).trace(
-                params, self.cache, jnp.zeros((S,), jnp.int32),
-                jnp.zeros((S,), jnp.float32), jnp.ones((S,), jnp.bool_),
-                self._key)
+            decode_step, donate_argnums=(1,)).trace(*decode_args)
         self.decode_compiled = self.decode_traced.lower().compile()
 
         def release_step(cache, slot):
@@ -184,20 +226,39 @@ class ServingEngine:
         return int(tok)
 
     def decode(self, tokens: np.ndarray, temperatures: np.ndarray,
-               active: Optional[np.ndarray] = None) -> np.ndarray:
+               active: Optional[np.ndarray] = None,
+               poison: Optional[np.ndarray] = None) -> np.ndarray:
         """One decode step for every slot: ``tokens (max_seqs,)`` are the
         last emitted token per slot (anything for free slots), returns
         the next token per slot. ``active`` (``(max_seqs,)`` bool,
         default all): slots outside it keep a frozen cursor — free slots
         never grow an attention prefix. Consumes and replaces the
-        donated cache."""
+        donated cache.
+
+        ``poison`` (quarantine engines only, ``(max_seqs,)`` f32,
+        default zeros) is added to each slot's sampling-path logits —
+        the deterministic fault-injection argument. On a quarantine
+        engine :attr:`last_finite` holds this step's per-slot finite
+        flags afterwards; on a plain engine it stays None (and a poison
+        array is refused — the fault would be silently dropped)."""
         if active is None:
             active = np.ones(self.max_seqs, np.bool_)
-        self.cache, toks = self.decode_compiled(
-            self.params, self.cache,
-            jnp.asarray(tokens, jnp.int32),
-            jnp.asarray(temperatures, jnp.float32),
-            jnp.asarray(active, jnp.bool_), self._next_key())
+        args = (self.params, self.cache,
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(temperatures, jnp.float32),
+                jnp.asarray(active, jnp.bool_), self._next_key())
+        if self.quarantine:
+            pvec = self._zero_poison if poison is None else \
+                jnp.asarray(poison, jnp.float32)
+            self.cache, toks, finite = self.decode_compiled(*args, pvec)
+            self.last_finite = np.asarray(finite)
+        else:
+            if poison is not None:
+                raise ValueError(
+                    "poison injection requires a quarantine engine "
+                    "(ServingEngine(..., quarantine=True)) — on a plain "
+                    "engine the fault would be silently dropped")
+            self.cache, toks = self.decode_compiled(*args)
         return np.asarray(toks)
 
     def release_slot(self, slot: int) -> None:
@@ -213,6 +274,57 @@ class ServingEngine:
                              f"[0, {self.max_seqs})")
         self.cache = self.release_compiled(self.cache,
                                            jnp.asarray(slot, jnp.int32))
+
+    # -- hot weight swap ----------------------------------------------------
+
+    def swap_params(self, new_params, *, relint: bool = True) -> None:
+        """Swap the serving weights in place with ZERO recompiles.
+
+        The params are a plain (non-donated) array argument of all three
+        AOT programs, so replacing the pytree retargets every subsequent
+        prefill/decode/release dispatch at the new weights — no retrace,
+        no recompile, no cache reallocation (the compile-storm counters
+        stay flat; asserted under ``recompile_guard`` in
+        ``tests/test_resilience.py``). In-flight sequences keep their
+        OLD-weight KV prefix and extend it under the new weights — the
+        standard serve-while-train rollover semantics; drain first
+        (:meth:`~apex_tpu.serving.scheduler.SlotScheduler.drain`) for a
+        clean generation boundary.
+
+        ``new_params`` must match the compiled programs' structure
+        exactly (same treedef, same leaf shapes/dtypes) — anything else
+        would retrace on next dispatch, which is exactly the compile
+        storm this method exists to avoid, so it is refused here at the
+        host boundary. ``relint=True`` re-runs the analysis engine's
+        donation/aliasing lint over the three compiled programs after
+        the swap (rule ``jaxpr-donation`` — the construction-time
+        self-check repeated at every rollover).
+        """
+        old_leaves, old_def = jax.tree_util.tree_flatten(self.params)
+        new_leaves, new_def = jax.tree_util.tree_flatten(new_params)
+        if old_def != new_def:
+            raise ValueError(
+                "swap_params: new params tree structure differs from "
+                "the compiled programs' — a swap must never retrace "
+                f"(old {old_def}, new {new_def})")
+        converted = []
+        for i, (o, n) in enumerate(zip(old_leaves, new_leaves)):
+            # one device_put per leaf: validate on the converted array
+            # and keep it, rather than transferring the model twice
+            n = jnp.asarray(n)
+            if o.shape != n.shape or o.dtype != n.dtype:
+                raise ValueError(
+                    f"swap_params: leaf {i} is {n.shape}/{n.dtype}, "
+                    f"compiled for {o.shape}/{o.dtype} — a swap must "
+                    "never retrace")
+            converted.append(n)
+        self.params = jax.tree_util.tree_unflatten(new_def, converted)
+        self.swaps += 1
+        if relint:
+            from apex_tpu.analysis.program import (lint_serving_engine,
+                                                   verify_findings)
+            verify_findings(lint_serving_engine(self),
+                            "ServingEngine.swap_params")
 
     # -- capacity -----------------------------------------------------------
 

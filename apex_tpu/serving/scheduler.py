@@ -28,6 +28,22 @@ timestamps) for the Chrome-trace export; an
 :class:`~apex_tpu.observability.slo.SLOTracker` (``slo=``) ingests each
 retirement for goodput/burn-rate. Both default off and neither adds
 device work (asserted in ``tests/test_reqtrace.py``).
+
+**Resilience** (docs/SERVING.md "Resilience"; the policy objects live in
+:mod:`apex_tpu.serving.resilience`): ``max_queue=`` bounds admission —
+an over-limit ``submit`` returns a typed
+:class:`~apex_tpu.serving.resilience.Rejection` instead of growing the
+queue without bound; ``default_deadline_ms=`` / per-request
+``deadline_ms`` expire requests while queued and mid-flight
+(``finish_reason="expired"``) and :meth:`~SlotScheduler.cancel` removes
+one by id; a quarantine engine retires a NaN-poisoned slot alone
+(``finish_reason="poisoned"``, CrashDump flight record); ``brownout=``
+sheds or caps admissions at SLO burn rate > 1; :meth:`~SlotScheduler
+.drain` + :meth:`~SlotScheduler.swap_params` roll weights with zero
+recompiles; ``fault_plan=`` scripts deterministic serving chaos
+(:class:`~apex_tpu.elastic.faults.FaultPlan` ``poison_logits`` /
+``slow_decode_s``). All host-side: every feature off leaves the three
+AOT programs byte-identical (``tests/test_resilience.py``).
 """
 
 from __future__ import annotations
@@ -35,13 +51,14 @@ from __future__ import annotations
 import collections
 import dataclasses
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
 from apex_tpu.observability import get_registry
 from apex_tpu.observability.reqtrace import (LATENCY_BUCKETS_MS,
                                              RequestRecord)
+from apex_tpu.serving.resilience import Rejection
 
 __all__ = ["Request", "Completion", "SlotScheduler"]
 
@@ -50,22 +67,28 @@ __all__ = ["Request", "Completion", "SlotScheduler"]
 class Request:
     """One generation request. ``temperature`` <= 0 is greedy;
     ``eos_token`` (optional) stops generation early; ``max_new_tokens``
-    always bounds it."""
+    always bounds it. ``deadline_ms`` (optional, > 0, measured from
+    submission) expires the request both while queued and mid-flight —
+    the scheduler's ``default_deadline_ms`` applies when None."""
     prompt: Sequence[int]
     max_new_tokens: int = 16
     temperature: float = 0.0
     eos_token: Optional[int] = None
     request_id: Optional[int] = None
+    deadline_ms: Optional[float] = None
 
 
 @dataclasses.dataclass
 class Completion:
     """A finished request: the generated tokens (prompt excluded), why
-    generation stopped (``"eos"`` | ``"length"`` | ``"capacity"``), and
+    generation stopped (``"eos"`` | ``"length"`` | ``"capacity"`` |
+    ``"expired"`` | ``"cancelled"`` | ``"poisoned"`` | ``"error"``), and
     the measured per-request latencies — ``queue_wait_ms`` (submit →
     slot), ``ttft_ms`` (submit → first token, queue wait included),
     ``tpot_ms`` (mean per-token after the first; None for single-token
-    requests), ``e2e_ms`` (submit → retire)."""
+    requests), ``e2e_ms`` (submit → retire). A request retired before
+    admission (queued expiry/cancel) has no slot-side latencies and an
+    empty token list."""
     request_id: int
     tokens: List[int]
     finish_reason: str
@@ -81,6 +104,15 @@ class _Active:
     generated: List[int]
     position: int            # prompt_len + len(generated), vs cache capacity
     record: RequestRecord
+    deadline_t: Optional[float] = None  # perf_counter seconds, absolute
+
+
+# retirement reasons with their own dedicated counter next to the
+# aggregate serve/retired (docs/OBSERVABILITY.md)
+_REASON_COUNTERS = {"expired": "serve/expired",
+                    "cancelled": "serve/cancelled",
+                    "poisoned": "serve/poisoned",
+                    "error": "serve/errors"}
 
 
 class SlotScheduler:
@@ -92,26 +124,67 @@ class SlotScheduler:
     dumps; ``slo`` (optional :class:`SLOTracker`) ingests each
     retirement. With both None the only lifecycle cost left is one
     timestamp per transition — the latency fields on completions and the
-    ``serve/*_ms`` histograms are always real measurements."""
+    ``serve/*_ms`` histograms are always real measurements.
 
-    def __init__(self, engine, registry=None, trace=None, slo=None):
+    Resilience knobs (all optional; see the module docstring and
+    docs/SERVING.md "Resilience"): ``max_queue`` (admission bound),
+    ``default_deadline_ms`` (deadline for requests that set none),
+    ``brownout`` (a :class:`~apex_tpu.serving.resilience
+    .BrownoutPolicy`), ``fault_plan`` (a :class:`~apex_tpu.elastic
+    .faults.FaultPlan` with serving faults — a poison plan requires a
+    quarantine engine and is refused here otherwise), ``dump_dir``
+    (where poison-quarantine CrashDumps land)."""
+
+    def __init__(self, engine, registry=None, trace=None, slo=None, *,
+                 max_queue: Optional[int] = None,
+                 default_deadline_ms: Optional[float] = None,
+                 brownout=None, fault_plan=None, dump_dir: str = "."):
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if default_deadline_ms is not None and default_deadline_ms <= 0:
+            raise ValueError("default_deadline_ms must be positive, "
+                             f"got {default_deadline_ms}")
+        if (fault_plan is not None
+                and getattr(fault_plan, "poison_logits", None)
+                and not engine.quarantine):
+            raise ValueError(
+                "fault_plan schedules poison_logits but the engine has "
+                "no quarantine check compiled in — the fault would be "
+                "silently dropped; build the engine with quarantine=True")
         self.engine = engine
         self._reg = registry if registry is not None else get_registry()
         self.trace = trace
         self.slo = slo
+        self.max_queue = max_queue
+        self.default_deadline_ms = default_deadline_ms
+        self.brownout = brownout
+        self.fault_plan = fault_plan
+        self.dump_dir = dump_dir
         self.queue: collections.deque = collections.deque()
         self.free: List[int] = list(range(engine.max_seqs))[::-1]
         self.active: Dict[int, _Active] = {}
         self.completed: List[Completion] = []
+        self.steps = 0              # decode steps executed (fault keying)
+        self.poison_dumps: List[str] = []
         self._tokens = np.zeros(engine.max_seqs, np.int32)
         self._temps = np.zeros(engine.max_seqs, np.float32)
         self._next_id = 0
+        self._in_flight_ids = set()
+        self._draining = False
+        # deadline-free schedulers skip the per-step queue walk entirely
+        self._any_deadlines = default_deadline_ms is not None
         self._tok_count = 0
         self._tok_t0: Optional[float] = None
 
     # -- submission ---------------------------------------------------------
 
-    def submit(self, request: Request) -> int:
+    def submit(self, request: Request) -> Union[int, Rejection]:
+        """Enqueue ``request`` and return its id — or a falsy typed
+        :class:`Rejection` under backpressure (``queue_full`` at the
+        ``max_queue`` bound, ``shed`` from the brownout policy,
+        ``draining`` during :meth:`drain`). Malformed input still
+        RAISES: a load condition is the server's problem, a bad request
+        is the caller's."""
         # validate HERE, not at admission: a bad request must bounce off
         # the caller, never kill the serving loop mid-step (by then it
         # has already been popped from the queue and other admissions
@@ -127,9 +200,48 @@ class SlotScheduler:
                 f"max_new_tokens must be >= 1, got "
                 f"{request.max_new_tokens} (the prefill always samples "
                 "one token)")
+        if request.deadline_ms is not None and request.deadline_ms <= 0:
+            raise ValueError(
+                f"deadline_ms must be positive, got "
+                f"{request.deadline_ms} (None means no deadline)")
+        if (request.request_id is not None
+                and request.request_id in self._in_flight_ids):
+            raise ValueError(
+                f"request_id {request.request_id} is already in flight "
+                "(queued or active) — completions are keyed by id, so a "
+                "duplicate would make one of them unaccountable")
+        # backpressure: typed rejections, never unbounded growth
+        if self._draining:
+            self._reg.counter("serve/rejected").inc()
+            return Rejection("draining", request.request_id,
+                             "scheduler is draining in-flight requests")
+        if (self.max_queue is not None
+                and len(self.queue) >= self.max_queue):
+            self._reg.counter("serve/rejected").inc()
+            return Rejection("queue_full", request.request_id,
+                             f"queue at max_queue={self.max_queue}")
+        if self.brownout is not None:
+            engaged = self.brownout.engaged()
+            self._reg.gauge("serve/brownout").set(1.0 if engaged else 0.0)
+            if engaged:
+                if self.brownout.shed:
+                    self._reg.counter("serve/shed").inc()
+                    return Rejection(
+                        "shed", request.request_id,
+                        "SLO burn rate over the brownout threshold")
+                capped = self.brownout.cap(request.max_new_tokens)
+                if capped != request.max_new_tokens:
+                    # cap a COPY: the caller's Request must not carry a
+                    # transient brownout's truncation to its retries or
+                    # to another replica
+                    request = dataclasses.replace(
+                        request, max_new_tokens=capped)
         if request.request_id is None:
             request.request_id = self._next_id
         self._next_id = max(self._next_id, request.request_id) + 1
+        self._in_flight_ids.add(request.request_id)
+        if request.deadline_ms is not None:
+            self._any_deadlines = True
         # the enqueue stamp: queue wait is measured from here, not
         # inferred from admission order
         record = RequestRecord(request_id=request.request_id,
@@ -142,14 +254,38 @@ class SlotScheduler:
     def pending(self) -> int:
         return len(self.queue) + len(self.active)
 
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def _deadline_t(self, request: Request,
+                    record: RequestRecord) -> Optional[float]:
+        ms = request.deadline_ms if request.deadline_ms is not None \
+            else self.default_deadline_ms
+        return None if ms is None else record.submit_t + ms / 1e3
+
     # -- the loop -----------------------------------------------------------
 
     def _retire(self, slot: int, reason: str, now: float) -> None:
         st = self.active.pop(slot)
         # zero the cursor: an idle slot left deep in the cache would keep
         # paying full-prefix attention on every later decode step
-        self.engine.release_slot(slot)
+        release_exc = None
+        try:
+            self.engine.release_slot(slot)
+        except Exception as exc:
+            # the HOST bookkeeping below (record retired, slot freed,
+            # completion visible, id released) must complete regardless
+            # — popping from active and then raising would strand the
+            # slot and the request forever. On the "error" path the
+            # engine is already known-broken (the failed dispatch may
+            # have consumed the donated cache) and the original fault
+            # is what propagates; on every other path the release
+            # failure itself re-raises AFTER the books are straight.
+            if reason != "error":
+                release_exc = exc
         self.free.append(slot)
+        self._in_flight_ids.discard(st.request.request_id)
         rec = st.record
         rec.retire_t = now
         rec.finish_reason = reason
@@ -159,6 +295,8 @@ class SlotScheduler:
             queue_wait_ms=rec.queue_wait_ms, ttft_ms=rec.ttft_ms,
             tpot_ms=rec.tpot_ms, e2e_ms=rec.e2e_ms))
         self._reg.counter("serve/retired").inc()
+        if reason in _REASON_COUNTERS:
+            self._reg.counter(_REASON_COUNTERS[reason]).inc()
         if rec.queue_wait_ms is not None:
             self._reg.histogram("serve/queue_wait_ms",
                                 LATENCY_BUCKETS_MS).observe(
@@ -176,6 +314,75 @@ class SlotScheduler:
             self.trace.append(rec)
         if self.slo is not None:
             self.slo.observe(rec)
+        if release_exc is not None:
+            raise release_exc
+
+    def _retire_queued(self, request: Request, record: RequestRecord,
+                       reason: str, now: float) -> None:
+        """Retire a request that never reached a slot (queued expiry or
+        cancel): no slot-side latencies, empty token list, NOT counted
+        as ``serve/retired`` (that counter means "slot freed") but under
+        the reason's own counter; still observed by the trace ring and
+        the SLO tracker (an expired request is a served-badly request —
+        it must hurt goodput, not vanish from it)."""
+        record.retire_t = now
+        record.finish_reason = reason
+        self._in_flight_ids.discard(request.request_id)
+        self.completed.append(Completion(
+            request.request_id, [], reason, e2e_ms=record.e2e_ms))
+        if reason in _REASON_COUNTERS:
+            self._reg.counter(_REASON_COUNTERS[reason]).inc()
+        if self.trace is not None:
+            self.trace.append(record)
+        if self.slo is not None:
+            self.slo.observe(record)
+
+    def _expire_queued(self, now: float) -> None:
+        if not self._any_deadlines:
+            return  # nothing queued can ever expire: skip the walk
+        kept: collections.deque = collections.deque()
+        while self.queue:
+            req, rec = self.queue.popleft()
+            deadline = self._deadline_t(req, rec)
+            if deadline is not None and now >= deadline:
+                self._retire_queued(req, rec, "expired", now)
+            else:
+                kept.append((req, rec))
+        self.queue = kept
+
+    def _quarantine(self, slot: int, now: float) -> None:
+        """Retire ONLY the poisoned slot (``finish_reason="poisoned"``,
+        cursor zeroed through the same AOT release program as any
+        retirement) and write a CrashDump-style flight record — the
+        serving twin of the health monitor's non-finite dump. Every
+        other slot keeps decoding untouched (the isolation contract:
+        their greedy streams are identical to a fault-free run)."""
+        from apex_tpu.observability.health import CrashDump
+
+        st = self.active[slot]
+        rec = st.record
+        self._retire(slot, "poisoned", now)
+        records = ([r.to_dict() for r in self.trace.last(16)]
+                   if self.trace is not None else [rec.to_dict()])
+        dump = CrashDump.from_payload(self.steps, dict(self._reg.snapshot()),
+                                      requests=records)
+        dump.config = {"slot": int(slot),
+                       "request_id": int(st.request.request_id),
+                       "prompt_len": int(rec.prompt_len),
+                       "generated": int(rec.generated),
+                       "finish_reason": "poisoned"}
+        self.poison_dumps.append(dump.write(self.dump_dir,
+                                            prefix="poison_dump"))
+
+    def _abort_in_flight(self) -> None:
+        """Exception-safety cleanup: a decode/prefill dispatch raised,
+        so every in-flight request is retired ``finish_reason="error"``
+        (records stamped, slots released where the engine still can,
+        completions visible) before the error propagates — nothing is
+        stranded in ``active`` holding a slot forever."""
+        now = time.perf_counter()
+        for slot in list(self.active):
+            self._retire(slot, "error", now)
 
     def _finish_reason(self, st: _Active, tok: int) -> Optional[str]:
         req = st.request
@@ -204,15 +411,31 @@ class SlotScheduler:
         admitted = 0
         while self.queue and self.free:
             req, rec = self.queue.popleft()
+            now = time.perf_counter()
+            deadline = self._deadline_t(req, rec)
+            if deadline is not None and now >= deadline:
+                # expired while waiting: never spend a prefill on it
+                self._retire_queued(req, rec, "expired", now)
+                continue
             slot = self.free.pop()
-            rec.admit_t = time.perf_counter()
+            rec.admit_t = now
             rec.slot = slot
-            first = self.engine.prefill(req.prompt, slot, req.temperature)
+            try:
+                first = self.engine.prefill(req.prompt, slot,
+                                            req.temperature)
+            except Exception:
+                # the popped request must not vanish: retire it as an
+                # error (host bookkeeping only — the slot never held a
+                # cursor) and surface the engine fault to the caller
+                self.free.append(slot)
+                self._retire_queued(req, rec, "error", now)
+                raise
             # prefill() syncs on the sampled token, so this stamp is the
             # honest first-token time (prefill-done == first-token: the
             # admission program samples it)
             rec.prefill_done_t = rec.first_token_t = time.perf_counter()
-            st = _Active(req, [], len(req.prompt), rec)
+            st = _Active(req, [], len(req.prompt), rec,
+                         deadline_t=deadline)
             self.active[slot] = st
             self._temps[slot] = req.temperature
             self._reg.counter("serve/admitted").inc()
@@ -225,25 +448,64 @@ class SlotScheduler:
         return admitted
 
     def step(self) -> int:
-        """Admit whatever fits, then run ONE decode step for the whole
-        slot grid (skipped when nothing is active). Returns the number of
-        tokens generated (prefill first-tokens included)."""
+        """Expire what's overdue, admit whatever fits (skipped while
+        draining), then run ONE decode step for the whole slot grid
+        (skipped when nothing is active). Returns the number of tokens
+        generated (prefill first-tokens included).
+
+        Exception safety: a raised engine fault retires every in-flight
+        request ``finish_reason="error"`` (slots released, records
+        stamped, completions visible) before re-raising — a dead decode
+        never strands ``active`` state."""
         if self._tok_t0 is None:
             self._tok_t0 = time.perf_counter()
         before = self._tok_count
-        self._admit()
-        if self.active:
-            mask = np.zeros(self.engine.max_seqs, np.bool_)
-            mask[list(self.active)] = True
-            nxt = self.engine.decode(self._tokens, self._temps, mask)
-            self._reg.counter("serve/decode_steps").inc()
-            # ONE stamp for the whole grid's tick (decode() synced on
-            # the fetched tokens) — the per-transition overhead contract
-            now = time.perf_counter()
-            # snapshot: _record may retire and free slots mid-harvest
-            for slot in list(self.active):
-                self._record(int(nxt[slot]), self.active[slot], slot, now,
-                             is_tick=True)
+        self._expire_queued(time.perf_counter())
+        try:
+            if not self._draining:
+                self._admit()
+            if self.active:
+                step_idx = self.steps + 1  # this decode step, 1-based
+                poison = None
+                if self.fault_plan is not None:
+                    self.fault_plan.before_decode(step_idx)
+                    pslot = self.fault_plan.poison_slot(step_idx)
+                    if pslot is not None:
+                        poison = np.zeros(self.engine.max_seqs,
+                                          np.float32)
+                        poison[pslot] = np.nan
+                mask = np.zeros(self.engine.max_seqs, np.bool_)
+                mask[list(self.active)] = True
+                nxt = self.engine.decode(self._tokens, self._temps, mask,
+                                         poison=poison)
+                self.steps = step_idx
+                self._reg.counter("serve/decode_steps").inc()
+                finite = (self.engine.last_finite
+                          if self.engine.quarantine else None)
+                # ONE stamp for the whole grid's tick (decode() synced on
+                # the fetched tokens) — the per-transition overhead
+                # contract
+                now = time.perf_counter()
+                # snapshot: _record may retire and free slots mid-harvest
+                for slot in list(self.active):
+                    if finite is not None and not finite[slot]:
+                        # the poison-slot quarantine: retire ONLY this
+                        # slot; its sampled token is garbage-from-NaN and
+                        # is discarded, every neighbor harvests normally
+                        self._quarantine(slot, now)
+                        continue
+                    self._record(int(nxt[slot]), self.active[slot], slot,
+                                 now, is_tick=True)
+                # mid-flight deadline enforcement: overdue survivors of
+                # the harvest retire now, slot released for the next
+                # admission
+                for slot in list(self.active):
+                    st = self.active[slot]
+                    if st.deadline_t is not None and now >= st.deadline_t:
+                        self._retire(slot, "expired", now)
+        except Exception:
+            self._abort_in_flight()
+            raise
         generated = self._tok_count - before
         self._reg.counter("serve/generated_tokens").inc(generated)
         self._reg.gauge("serve/queue_depth").set(len(self.queue))
@@ -253,6 +515,68 @@ class SlotScheduler:
             self._reg.gauge("serve/tokens_per_sec").set(
                 self._tok_count / elapsed)
         return generated
+
+    # -- resilience surface -------------------------------------------------
+
+    def cancel(self, request_id: int) -> bool:
+        """Cancel one request by id, wherever it is: still queued (it
+        just never admits) or mid-flight (retired now,
+        ``finish_reason="cancelled"``, slot released). Returns False for
+        an unknown/already-finished id — cancelling twice is a no-op,
+        not an error (the client's disconnect usually races the
+        completion)."""
+        now = time.perf_counter()
+        for i, (req, rec) in enumerate(self.queue):
+            if req.request_id == request_id:
+                del self.queue[i]
+                self._retire_queued(req, rec, "cancelled", now)
+                return True
+        for slot, st in list(self.active.items()):
+            if st.request.request_id == request_id:
+                self._retire(slot, "cancelled", now)
+                return True
+        return False
+
+    def drain(self, deadline_s: Optional[float] = None
+              ) -> Dict[int, Completion]:
+        """Graceful drain: stop admitting (concurrent :meth:`submit`
+        calls get ``Rejection(reason="draining")``), keep stepping until
+        every IN-FLIGHT request finishes, and return this drain's
+        completions. Queued requests stay queued — after a weight swap
+        they are served by the new weights, which is the rollover point
+        of draining at all. ``deadline_s`` bounds the wait: leftovers
+        retire ``finish_reason="expired"`` when it runs out — the drain
+        budget is a deadline the SERVER imposed, so these are
+        server-side failures that count against goodput
+        (:data:`~apex_tpu.observability.slo.FAILED_REASONS`), unlike a
+        user's :meth:`cancel`. Admission resumes when the method
+        returns (``serve/drains`` counts calls)."""
+        self._draining = True
+        t0 = time.perf_counter()
+        n0 = len(self.completed)
+        try:
+            while self.active:
+                if (deadline_s is not None
+                        and time.perf_counter() - t0 >= deadline_s):
+                    now = time.perf_counter()
+                    for slot in list(self.active):
+                        self._retire(slot, "expired", now)
+                    break
+                self.step()
+        finally:
+            self._draining = False
+        self._reg.counter("serve/drains").inc()
+        return {c.request_id: c for c in self.completed[n0:]}
+
+    def swap_params(self, new_params) -> None:
+        """Hot weight swap through :meth:`ServingEngine.swap_params`
+        (zero recompiles, structure/shape/dtype-checked, donation
+        re-linted), counted as ``serve/swaps``. Safe mid-:meth:`run`:
+        in-flight requests keep their old-weight KV prefix and finish
+        under the new weights; call :meth:`drain` first for a clean
+        generation boundary."""
+        self.engine.swap_params(new_params)
+        self._reg.counter("serve/swaps").inc()
 
     def drain_completed(self) -> List[Completion]:
         """Pop and return the completion buffer. A long-lived server
@@ -270,6 +594,15 @@ class SlotScheduler:
         including ones submitted before the call); earlier runs' results
         stay in :attr:`completed` until drained.
 
+        Backpressure: a closed batch knows the rest of its work, so a
+        ``queue_full`` rejection PACES the run — the request waits
+        host-side and resubmits as the queue drains (the queue bound
+        still holds throughout; silently dropping work a later step
+        could serve would be a shedding decision the caller never
+        made). ``shed``/``draining`` rejections are final and the
+        request is dropped (counted on ``serve/shed``/``serve/
+        rejected``), exactly as for a live ``submit`` caller.
+
         ``no_recompile=True`` wraps the loop in the analysis engine's
         :class:`~apex_tpu.analysis.program.recompile_guard`: after the
         first (warmup) iteration, any movement of the compile-storm
@@ -285,12 +618,28 @@ class SlotScheduler:
         else:
             guard = nullcontext()
         n0 = len(self.completed)
-        for r in requests:
-            self.submit(r)
+        waiting = collections.deque(requests)
+
+        def feed():
+            while waiting:
+                if (self.max_queue is not None
+                        and len(self.queue) >= self.max_queue):
+                    # wait for the next step to drain the queue WITHOUT
+                    # probing submit(): a paced retry is not a refused
+                    # submission, so it must not tick serve/rejected
+                    return
+                res = self.submit(waiting[0])
+                if isinstance(res, Rejection) \
+                        and res.reason == "queue_full":
+                    return  # raced the bound: resubmit after a step
+                waiting.popleft()  # admitted, or finally rejected
+
+        feed()
         steps = 0
         with guard:
-            while self.pending:
+            while self.pending or waiting:
                 self.step()
+                feed()
                 steps += 1
                 if no_recompile and steps == 1:
                     guard.rebase()  # first-dispatch host paths warmed

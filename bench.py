@@ -939,6 +939,25 @@ def bench_gpt_decode(iters=40, warmup=5, prefill_iters=5, max_len=1024,
                            for q in (50, 95, 99)})
         extras["goodput"] = round(tracker.goodput(), 4)
         extras["slo"] = "; ".join(t.describe() for t in slo_targets)
+        # resilience wiring (docs/SERVING.md "Resilience"): a burst at
+        # 4x the queue bound against the SAME compiled engine — the
+        # admission bound must hold with typed rejections while every
+        # ADMITTED request still completes (rides the leg: no new
+        # config-budget entry, no extra AOT compiles)
+        t2 = SLOTracker(slo_targets, registry=sreg, on_violation="skip")
+        over = SlotScheduler(eng, registry=sreg, slo=t2,
+                             max_queue=slots,
+                             default_deadline_ms=120000.0)
+        burst = [over.submit(Request(prompt=prompt[: 1 + i],
+                                     max_new_tokens=4))
+                 for i in range(4 * slots)]
+        over.run([])
+        snap = sreg.snapshot()
+        extras["rejected"] = int(snap.get("serve/rejected", 0.0))
+        extras["expired"] = int(snap.get("serve/expired", 0.0))
+        extras["overload_admitted_goodput"] = round(t2.goodput(), 4)
+        assert extras["rejected"] == sum(
+            1 for b in burst if not isinstance(b, int))
         return extras
 
     def measure(slots):

@@ -21,8 +21,9 @@ import numpy as np
 
 from apex_tpu.models import GPTConfig, GPTModel
 from apex_tpu.observability.registry import MetricsRegistry
-from apex_tpu.serving import (Request, RequestTrace, ServingEngine,
-                              SLOTarget, SLOTracker, SlotScheduler)
+from apex_tpu.serving import (Rejection, Request, RequestTrace,
+                              ServingEngine, SLOTarget, SLOTracker,
+                              SlotScheduler)
 
 
 def main(argv=None):
@@ -43,6 +44,15 @@ def main(argv=None):
     ap.add_argument("--trace-out", default=None,
                     help="write the per-request Chrome trace (one "
                          "swimlane per slot) to this path")
+    ap.add_argument("--max-queue", type=int, default=None,
+                    help="admission bound: submissions past this queue "
+                         "depth get a typed Rejection(queue_full) "
+                         "instead of growing the queue without bound "
+                         "(docs/SERVING.md Resilience)")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="default per-request deadline: requests expire "
+                         "(finish_reason 'expired') while queued or "
+                         "mid-flight once this budget elapses")
     ap.add_argument("--ttft-slo-ms", type=float, default=5000.0,
                     help="demo SLO: TTFT p95 threshold")
     ap.add_argument("--tpot-slo-ms", type=float, default=1000.0,
@@ -70,15 +80,21 @@ def main(argv=None):
     trace = RequestTrace(capacity=256)
     slo = SLOTracker(targets, registry=reg, trace=trace,
                      on_violation="skip")
-    sched = SlotScheduler(engine, registry=reg, trace=trace, slo=slo)
+    sched = SlotScheduler(engine, registry=reg, trace=trace, slo=slo,
+                          max_queue=args.max_queue,
+                          default_deadline_ms=args.deadline_ms)
     rng = np.random.RandomState(0)
+    rejections = []
     for i in range(args.requests):
         prompt = rng.randint(1, args.vocab,
                              size=1 + i % args.prefill_len).tolist()
-        sched.submit(Request(prompt=prompt,
-                             max_new_tokens=1 + (args.max_new_tokens
-                                                 * (i + 1)) // 2,
-                             temperature=0.0 if i % 2 == 0 else 0.8))
+        res = sched.submit(Request(prompt=prompt,
+                                   max_new_tokens=1 + (args.max_new_tokens
+                                                       * (i + 1)) // 2,
+                                   temperature=0.0 if i % 2 == 0 else 0.8))
+        if isinstance(res, Rejection):
+            rejections.append(res)
+            print(f"  req {i} rejected: {res.reason} ({res.detail})")
 
     # the steady-state loop runs under the analysis engine's
     # zero-recompile guard (docs/ANALYSIS.md): after the first (warmup)
@@ -105,6 +121,10 @@ def main(argv=None):
     results = {c.request_id: c for c in sched.completed}
     for rid in sorted(results):
         c = results[rid]
+        if c.queue_wait_ms is None:  # retired before admission
+            print(f"req {rid}: {len(c.tokens)} tokens, "
+                  f"finished by {c.finish_reason}")
+            continue
         print(f"req {rid}: {len(c.tokens)} tokens, "
               f"finished by {c.finish_reason} "
               f"(wait {c.queue_wait_ms:.1f}ms, ttft {c.ttft_ms:.1f}ms, "
@@ -134,12 +154,22 @@ def main(argv=None):
            if k.startswith(("ttft", "tpot"))})
     print(f"goodput {goodput:.3f} under SLO "
           f"[{'; '.join(t.describe() for t in targets)}]")
+    # the resilience counts (docs/SERVING.md "Resilience"): typed
+    # rejections at the admission bound, expiries against the deadline
+    full_snap = reg.snapshot()
+    rejected = int(full_snap.get("serve/rejected", 0.0))
+    expired = int(full_snap.get("serve/expired", 0.0))
+    print(f"rejected {rejected} (typed: "
+          f"{[r.reason for r in rejections]}), expired {expired} "
+          f"(max_queue={args.max_queue}, deadline_ms={args.deadline_ms})")
     if args.trace_out:
         trace.write_chrome_trace(args.trace_out)
         print(f"chrome request trace ({len(trace)} records, one lane "
               f"per slot) -> {args.trace_out}")
     return {"completions": results, "metrics": snap, "latency": latency,
-            "goodput": goodput, "slo": [t.describe() for t in targets]}
+            "goodput": goodput, "slo": [t.describe() for t in targets],
+            "rejected": rejected, "expired": expired,
+            "rejections": rejections}
 
 
 if __name__ == "__main__":

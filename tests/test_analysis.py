@@ -180,19 +180,27 @@ def _plant_metrics_doc(tmp_path):
            # gauges) and train/* (rank-side step counters) join the
            # contract
            "    reg.gauge('fleet/rogue_skew').set(x)\n"
-           "    reg.counter('train/rogue_steps').inc(x)\n")
+           "    reg.counter('train/rogue_steps').inc(x)\n"
+           # the PR 15 resilience call shapes: reason-keyed retirement
+           # counters and the brownout gauge — an undocumented
+           # rejection/expiry/poison counter must fire like any other
+           "    reg.counter('serve/rogue_rejected').inc()\n"
+           "    reg.counter('serve/rogue_poisoned').inc()\n"
+           "    reg.gauge('serve/rogue_brownout').set(x)\n")
     _write(tmp_path, "docs/OBSERVABILITY.md", "| nothing documented |\n")
 
 
 def _expect_metrics_doc(findings):
     undoc = [f for f in findings if f.kind == "UNDOC"]
-    # record x2 + gauge x4 + counter x2 + hist x2
-    assert len(undoc) == 10
+    # record x2 + gauge x5 + counter x4 + hist x2
+    assert len(undoc) == 13
     for name in ("health/rogue_metric", "health/<>/rogue_family",
                  "perf/rogue_attribution", "ckpt/rogue_bytes",
                  "serve/rogue_ms", "serve/rogue_wait_ms",
                  "slo/rogue_goodput", "elastic/rogue_world",
-                 "fleet/rogue_skew", "train/rogue_steps"):
+                 "fleet/rogue_skew", "train/rogue_steps",
+                 "serve/rogue_rejected", "serve/rogue_poisoned",
+                 "serve/rogue_brownout"):
         assert any(name in f.message for f in undoc), name
 
 
@@ -207,6 +215,9 @@ def _plant_metric_family(tmp_path):
            "    reg.gauge('elastic/world_size').set(x)\n"      # known (PR 13)
            "    reg.gauge('fleet/step_skew').set(x)\n"         # known (PR 14)
            "    reg.counter('train/steps').inc()\n"            # known (PR 14)
+           "    reg.counter('serve/rejected').inc()\n"         # known (PR 15)
+           "    reg.counter('serve/poisoned').inc()\n"         # known (PR 15)
+           "    reg.gauge('serve/brownout').set(x)\n"          # known (PR 15)
            "    reg.gauge('no_slash_name').set(x)\n")          # unprefixed
     # even a documented row does not excuse an unregistered FAMILY
     _write(tmp_path, "docs/OBSERVABILITY.md", "| `newfam/widgets` |\n")
@@ -434,7 +445,9 @@ def test_documenting_fixes_metrics_doc(tmp_path):
            "| `perf/rogue_attribution` | `ckpt/rogue_bytes` |\n"
            "| `serve/rogue_ms` | `serve/rogue_wait_ms` |\n"
            "| `slo/rogue_goodput` | `elastic/rogue_world` |\n"
-           "| `fleet/rogue_skew` | `train/rogue_steps` |\n")
+           "| `fleet/rogue_skew` | `train/rogue_steps` |\n"
+           "| `serve/rogue_rejected` | `serve/rogue_poisoned` |\n"
+           "| `serve/rogue_brownout` |\n")
     findings, _ = rule_metrics_doc(str(tmp_path))
     assert not findings
 

@@ -133,6 +133,29 @@ def test_gpt_serve_runs(tmp_path):
     lanes = {e["args"]["name"] for e in doc["traceEvents"]
              if e.get("ph") == "M" and e["name"] == "thread_name"}
     assert lanes == {"queue", "slot 0", "slot 1"}
+    # resilience counts ride the payload: nothing rejected or expired
+    # in an unconstrained run
+    assert payload["rejected"] == 0 and payload["expired"] == 0
+
+
+def test_gpt_serve_resilience_flags():
+    """--max-queue bounds admission with typed rejections and
+    --deadline-ms expires overdue requests — the counts the demo prints
+    (docs/SERVING.md "Resilience")."""
+    import gpt_serve
+    # every request is submitted before the loop starts, so a 6-request
+    # run against --max-queue 2 deterministically rejects 4
+    payload = gpt_serve.main(["--requests", "6", "--max-new-tokens", "2",
+                              "--max-queue", "2"])
+    assert payload["rejected"] == 4 and payload["expired"] == 0
+    assert [r.reason for r in payload["rejections"]] == ["queue_full"] * 4
+    assert len(payload["completions"]) == 2  # the two that fit served
+    # a microscopic default deadline expires everything in the queue
+    payload = gpt_serve.main(["--requests", "3", "--max-new-tokens", "2",
+                              "--deadline-ms", "0.001"])
+    assert payload["expired"] == 3 and payload["rejected"] == 0
+    assert all(c.finish_reason == "expired"
+               for c in payload["completions"].values())
 
 
 def test_dcgan_amp_runs():
