@@ -583,30 +583,87 @@ def _check_spec(spec: dict, tables: dict, where: str,
     return ok
 
 
-def _bench_table(bench_path: str):
-    """The literal ``BENCH_TRAIN_CONFIGS`` dict from bench.py, or None."""
-    with open(bench_path) as f:
-        tree = ast.parse(f.read(), filename=bench_path)
+def _literal_assign(path: str, name: str):
+    """The literal value of module-level ``name = <literal>``, or None."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign):
             for target in node.targets:
-                if isinstance(target, ast.Name) \
-                        and target.id == "BENCH_TRAIN_CONFIGS":
+                if isinstance(target, ast.Name) and target.id == name:
                     return ast.literal_eval(node.value)
     return None
+
+
+def _bench_table(bench_path: str):
+    """The literal ``BENCH_TRAIN_CONFIGS`` dict from bench.py, or None."""
+    return _literal_assign(bench_path, "BENCH_TRAIN_CONFIGS")
 
 
 def _decode_slo_table(bench_path: str):
     """The literal ``DECODE_SLO`` tuple from bench.py, or None."""
-    with open(bench_path) as f:
-        tree = ast.parse(f.read(), filename=bench_path)
+    return _literal_assign(bench_path, "DECODE_SLO")
+
+
+def _class_init_params(path: str, class_name: str):
+    """Parameter names of ``class_name.__init__`` (AST, no import), or
+    None when the class or its ``__init__`` is absent."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
     for node in ast.walk(tree):
-        if isinstance(node, ast.Assign):
-            for target in node.targets:
-                if isinstance(target, ast.Name) \
-                        and target.id == "DECODE_SLO":
-                    return ast.literal_eval(node.value)
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            for stmt in node.body:
+                if isinstance(stmt, ast.FunctionDef) \
+                        and stmt.name == "__init__":
+                    a = stmt.args
+                    return {p.arg for p in (*a.posonlyargs, *a.args,
+                                            *a.kwonlyargs)} - {"self"}
     return None
+
+
+def _check_decode_configs(repo: str, bench_path: str, findings: list,
+                          notes: list):
+    """The paged serving legs: ``BENCH_DECODE_CONFIGS`` keys must be
+    real ``PagedServingEngine.__init__`` parameters — bench.py builds
+    the engine by ``**spec``, so an unknown key would TypeError only at
+    bench runtime (and a renamed engine knob would silently strand the
+    leg)."""
+    engine_path = os.path.join(repo, PACKAGE, "serving", "engine.py")
+    try:
+        allowed = _class_init_params(engine_path, "PagedServingEngine")
+        table = _literal_assign(bench_path, "BENCH_DECODE_CONFIGS")
+    except (OSError, SyntaxError, ValueError) as e:
+        findings.append(Finding("ast-bench-configs", "MISSING",
+                                "bench.py BENCH_DECODE_CONFIGS", str(e)))
+        return
+    if allowed is None:
+        findings.append(Finding(
+            "ast-bench-configs", "MISSING", "serving/engine.py",
+            "no PagedServingEngine.__init__ to validate "
+            "BENCH_DECODE_CONFIGS against"))
+        return
+    if table is None:
+        findings.append(Finding(
+            "ast-bench-configs", "MISSING", "bench.py",
+            "no literal BENCH_DECODE_CONFIGS table (the paged decode "
+            "legs must state their engine config declaratively)"))
+        return
+    for leg, spec in table.items():
+        where = f"bench.py BENCH_DECODE_CONFIGS[{leg!r}]"
+        bad = [k for k in spec
+               if k not in allowed] if isinstance(spec, dict) else None
+        if bad is None:
+            findings.append(Finding(
+                "ast-bench-configs", "UNKNOWN", where,
+                f"expected a dict of engine kwargs, got "
+                f"{type(spec).__name__}"))
+        elif bad:
+            findings.append(Finding(
+                "ast-bench-configs", "UNKNOWN", where,
+                f"{bad} are not PagedServingEngine.__init__ "
+                f"parameters"))
+        else:
+            notes.append(f"ok       {where}: {len(spec)} keys")
 
 
 def _check_decode_slo(bench_path: str, findings: list, notes: list):
@@ -714,6 +771,7 @@ def rule_bench_configs(repo: str) -> Findings:
                 notes.append(f"ok       {where}: {nkeys} keys")
 
     _check_decode_slo(bench_path, findings, notes)
+    _check_decode_configs(repo, bench_path, findings, notes)
 
     allowed = own_params | tables["GPTConfig"]
     for lineno, kws in calls:

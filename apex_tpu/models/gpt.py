@@ -31,7 +31,8 @@ import jax.numpy as jnp
 from apex_tpu.normalization import fused_layer_norm_affine
 from apex_tpu.ops.dropout import dropout
 from apex_tpu.remat import RematPolicy, tag as _remat_tag
-from apex_tpu.ops.flash_attention import decode_attention, flash_attention
+from apex_tpu.ops.flash_attention import (decode_attention, flash_attention,
+                                          paged_decode_attention)
 from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
 from apex_tpu.transformer import tensor_parallel as tp_mod
 from apex_tpu.transformer.parallel_state import TENSOR_AXIS
@@ -493,7 +494,15 @@ class GPTModel:
                 kv_cache=None, positions: Optional[jnp.ndarray] = None,
                 slot=None, prompt_len=None,
                 last_logit_only: bool = False,
-                active: Optional[jnp.ndarray] = None):
+                active: Optional[jnp.ndarray] = None,
+                block_row: Optional[jnp.ndarray] = None,
+                block_tables: Optional[jnp.ndarray] = None,
+                lengths: Optional[jnp.ndarray] = None,
+                append_block_ids: Optional[jnp.ndarray] = None,
+                append_offsets: Optional[jnp.ndarray] = None,
+                cow_src: Optional[jnp.ndarray] = None,
+                cow_dst: Optional[jnp.ndarray] = None,
+                mean_context: Optional[float] = None):
         """The cache-threading entry point (docs/SERVING.md).
 
         Without ``kv_cache`` this is :meth:`__call__`. With a
@@ -525,6 +534,20 @@ class GPTModel:
         the serving engine always sets this (parity tests use the
         default full logits).
 
+        With a :class:`~apex_tpu.serving.cache.PagedKVCache` the same
+        two legs run against the global block pool instead
+        (docs/SERVING.md "Paged serving"): **paged prefill** writes the
+        collected K/V into the pool blocks named by ``block_row``
+        (``(P // block_size,)`` int32, null-padded); **paged decode**
+        (``block_row=None``) first resolves any copy-on-write pairs
+        (``cow_src``/``cow_dst``, null pairs no-op), reads each slot's
+        context through ``block_tables``/``lengths`` with the bounded
+        paged kernel — HBM per step is O(actual context), not
+        O(max_len) — and appends the new token at
+        ``append_block_ids``/``append_offsets`` (host-computed; null
+        entries drop the write). ``mean_context`` only prices the
+        kernel's CostEstimate for pyprof.
+
         Both legs are inference-mode (no dropout) and are meant to be
         AOT-compiled with the cache donated — see
         :class:`apex_tpu.serving.engine.ServingEngine`.
@@ -532,6 +555,17 @@ class GPTModel:
         if kv_cache is None:
             return self(params, tokens, dropout_rng)
         self._require_cacheable()
+        # lazy: serving -> engine -> gpt would cycle at import time
+        from apex_tpu.serving.cache import PagedKVCache
+        if isinstance(kv_cache, PagedKVCache):
+            if block_row is not None:
+                return self._paged_prefill_forward(
+                    params, tokens, kv_cache, block_row, prompt_len,
+                    last_logit_only)
+            return self._paged_decode_forward(
+                params, tokens, kv_cache, block_tables, lengths,
+                append_block_ids, append_offsets, cow_src, cow_dst,
+                mean_context)
         if slot is not None:
             return self._prefill_forward(params, tokens, kv_cache, slot,
                                          prompt_len, last_logit_only)
@@ -615,6 +649,114 @@ class GPTModel:
         # cursor — free slots must not creep one garbage position per
         # step (see KVCache.append)
         return logits, cache.append(k_new, v_new, active)
+
+    def _paged_decode_layer(self, lp: dict, x: jnp.ndarray, layer_pool,
+                            block_tables: jnp.ndarray,
+                            lengths: jnp.ndarray,
+                            mean_context: Optional[float]):
+        """One layer of the paged decode step: like :meth:`_decode_layer`
+        but the context comes through each slot's block table, so only
+        ~ceil(cursor/block_size) pool blocks are streamed per slot."""
+        cfg = self.cfg
+        h = self._ln(lp["ln1"], x)
+        with jax.named_scope("gpt_attention"):
+            qkv, _ = self.qkv(lp["qkv"], h)       # (S, 1, 3*hidden)
+            S = qkv.shape[0]
+            qkv = qkv.reshape(S, cfg.num_attention_heads, 3 * cfg.head_dim)
+            q, k_new, v_new = jnp.split(qkv, 3, axis=-1)   # (S, H, D)
+            kp, vp, ksc, vsc = layer_pool
+            ctx = paged_decode_attention(
+                q, kp, vp, block_tables, lengths, k_new=k_new,
+                v_new=v_new, k_scale=ksc, v_scale=vsc,
+                mean_context=mean_context, use_pallas=cfg.use_flash)
+            out, _ = self.proj(lp["proj"], ctx.reshape(S, 1, -1))
+        x = x + out
+        x = x + self._mlp(lp, self._ln(lp["ln2"], x))
+        return x, (k_new, v_new)
+
+    def _paged_prefill_forward(self, params, tokens, cache, block_row,
+                               prompt_len, last_logit_only=False):
+        cfg = self.cfg
+        b, P = tokens.shape
+        if b != 1:
+            raise ValueError(f"prefill is per-request: tokens must be "
+                             f"(1, P), got {tokens.shape}")
+        if P % cache.block_size != 0:
+            raise ValueError(f"paged prefill window {P} must be a "
+                             f"multiple of block_size {cache.block_size}")
+        if prompt_len is None:
+            prompt_len = P
+        elif isinstance(prompt_len, int):
+            if not 0 < prompt_len <= P:
+                raise ValueError(f"prompt_len {prompt_len} outside the "
+                                 f"written window (1, {P}]")
+        else:
+            prompt_len = jnp.clip(jnp.asarray(prompt_len, jnp.int32), 1,
+                                  P)
+        x = self.embed(params, tokens)
+
+        def body(x, lp):
+            return self._layer(lp, x, collect_kv=True)
+
+        x, (k_all, v_all) = scan_stable_vma(body, x, params["layers"],
+                                            unroll=cfg.layer_scan_unroll)
+        x = self._ln(params["final_ln"], x)
+        if last_logit_only:
+            x = jax.lax.dynamic_slice_in_dim(
+                x, jnp.asarray(prompt_len, jnp.int32) - 1, 1, axis=1)
+        logits = self.logits(params, x)
+        # ys stacked (L, 1, H, P, D) -> (L, H, P, D) block-scattered
+        # into the pool; null block_row entries absorb the padding
+        cache = cache.write_prompt_blocks(k_all[:, 0], v_all[:, 0],
+                                          jnp.asarray(block_row,
+                                                      jnp.int32))
+        return logits, cache
+
+    def _paged_decode_forward(self, params, tokens, cache, block_tables,
+                              lengths, block_ids, offsets, cow_src,
+                              cow_dst, mean_context=None):
+        cfg = self.cfg
+        if tokens.ndim != 2 or tokens.shape[1] != 1:
+            raise ValueError(f"decode tokens must be (max_seqs, 1), got "
+                             f"{tokens.shape}")
+        if block_tables is None or lengths is None or block_ids is None \
+                or offsets is None:
+            raise ValueError("paged decode needs block_tables, lengths, "
+                             "append_block_ids and append_offsets")
+        lengths = jnp.asarray(lengths, jnp.int32)
+        # copy-on-write FIRST: pending shared blocks become private
+        # before this step reads or writes them (null pairs no-op, so
+        # the program shape never changes — zero-recompile)
+        if cow_src is not None:
+            cache = cache.cow_copy(jnp.asarray(cow_src, jnp.int32),
+                                   jnp.asarray(cow_dst, jnp.int32))
+        with jax.named_scope("gpt_embed"):
+            h = self.embedding(params["embedding"]["word"], tokens)
+            pos = jnp.take(
+                params["embedding"]["position"],
+                jnp.clip(lengths, 0, cfg.max_position_embeddings - 1),
+                axis=0)[:, None]
+            x = (h + pos).astype(cfg.compute_dtype)
+
+        xs = (params["layers"], cache.k, cache.v)
+        if cache.quantized:
+            xs = xs + (cache.k_scale, cache.v_scale)
+
+        def body(x, lp_c):
+            lp, kp, vp = lp_c[:3]
+            ksc, vsc = (lp_c[3], lp_c[4]) if cache.quantized else (None,
+                                                                   None)
+            return self._paged_decode_layer(lp, x, (kp, vp, ksc, vsc),
+                                            block_tables, lengths,
+                                            mean_context)
+
+        x, (k_new, v_new) = scan_stable_vma(body, x, xs,
+                                            unroll=cfg.layer_scan_unroll)
+        x = self._ln(params["final_ln"], x)
+        logits = self.logits(params, x)[:, 0]
+        return logits, cache.append(k_new, v_new,
+                                    jnp.asarray(block_ids, jnp.int32),
+                                    jnp.asarray(offsets, jnp.int32))
 
     def sp_grad_sync(self, grads: dict) -> dict:
         """Megatron-LM allreduces the grads of ``sequence_parallel``-marked

@@ -55,7 +55,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flash_attention", "mha_reference", "supports_flash",
-           "dropout_keep_mask", "decode_attention"]
+           "dropout_keep_mask", "decode_attention", "supports_paged",
+           "paged_decode_attention"]
 
 NEG_INF = -1e30
 
@@ -1000,11 +1001,13 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
 #   SMEM, and SKIPS the compute of cache blocks entirely past the cursor
 #   (a sequence at position t prices O(t) MXU work). NOTE the grid — and
 #   therefore the pipelined HBM->VMEM block fetches — is still shaped by
-#   max_len: v1 streams the full stripe and skips only the math, so the
-#   memory-bound cost is O(max_len) per slot per step. Bounding the
-#   fetches too (scalar-prefetched per-slot block counts driving manual
-#   DMA) is the known next optimization; docs/SERVING.md carries the
-#   same caveat so capacity/roofline readings stay honest;
+#   max_len HERE: this dense-cache kernel streams the full stripe and
+#   skips only the math, so its memory-bound cost is O(max_len) per slot
+#   per step. The paged kernel below (``paged_decode_attention``) bounds
+#   the fetches too — scalar-prefetched block tables whose index map
+#   clamps past the cursor, so Pallas elides the repeat DMAs and HBM
+#   traffic is O(actual context); dense engines keep this kernel, paged
+#   engines (docs/SERVING.md "Paged serving") take the bounded grid;
 # - optionally dequantizes an int8 cache blockwise in VMEM against
 #   per-(position, head) fp32 scales — the cache stays int8 in HBM, which
 #   is where a decode step's bytes actually go;
@@ -1232,3 +1235,281 @@ def decode_attention(q, k, v, lengths, k_new=None, v_new=None,
             out = _merge_current(out, lse, q, k_new, v_new,
                                  float(softmax_scale), q.dtype)
         return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# paged decode kernel — bounded-grid attention over a block-pool KV cache
+# ---------------------------------------------------------------------------
+#
+# The v2 serving kernel (docs/SERVING.md "Paged serving"): vLLM-style
+# PagedAttention (Kwon et al.) brought to Pallas. The dense kernel above
+# streams a per-slot ``(max_len, d)`` stripe and only SKIPS the compute
+# past the cursor — its pipelined HBM fetches stay O(max_len). Here the
+# cache is a global block pool ``(num_blocks, h, block_size, d)`` and each
+# slot owns an int32 row of pool indices (its block table), so:
+#
+# - the per-slot block table and cursor ride as SCALAR-PREFETCH arguments
+#   (``pltpu.PrefetchScalarGridSpec``): they are resident before the grid
+#   starts, and the K/V BlockSpec index maps read them to aim each fetch
+#   at ``table[slot, j]`` — the pool block holding that slot's j-th
+#   logical block;
+# - the fetch sequence is bounded by the cursor: past the slot's last
+#   valid block the index map CLAMPS to that block, so consecutive grid
+#   steps resolve to the SAME pool block and the Pallas pipeline elides
+#   the re-fetch (equal block index => no new DMA) — HBM traffic per slot
+#   per step is O(actual_context), not O(max_len). Compute past the
+#   cursor is skipped with the same ``@pl.when`` the dense kernel uses;
+# - the online-softmax recurrence, the int8 blockwise dequant (scales are
+#   pooled alongside the blocks), the -inf empty-row convention and the
+#   exact two-way ``_merge_current`` with the current token are the dense
+#   kernel's, unchanged — the parity tests pin all of them to
+#   ``mha_reference(kv_length=)``;
+# - ``mean_context`` (an expected-occupancy hint, tokens) sizes the
+#   ``pl.CostEstimate`` attached to the kernel so the pyprof roofline
+#   prices the fetch-elided traffic instead of the worst-case table span
+#   (``pyprof/model.py`` reads it off the ``pallas_call`` eqn). It never
+#   changes the math — only the modeled bytes.
+
+def supports_paged(block_size: int, d: int) -> bool:
+    """Pallas eligibility for the paged decode kernel: lane-aligned
+    blocks on real TPUs; anything goes under interpret mode (the CPU
+    CI path — alignment is a hardware tiling constraint, not a
+    correctness one)."""
+    if _interp():
+        return block_size >= 1 and d >= 1
+    return block_size % 128 == 0 and d % 8 == 0
+
+
+def _paged_decode_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, ksc_ref,
+                         vsc_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+                         scale, block_size, n_blocks):
+    s, j = pl.program_id(0), pl.program_id(2)
+    length = len_ref[s]
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # skip the COMPUTE past the cursor; the FETCH is already bounded by
+    # the clamped index map (see the section comment)
+    @pl.when(j * block_size < length)
+    def _():
+        q = q_ref[0].astype(jnp.float32)          # (1, d)
+        k = k_ref[0, 0]                           # (block_size, d)
+        v = v_ref[0, 0]
+        if ksc_ref is not None:
+            # int8 pool: dequantize blockwise in VMEM against the pooled
+            # per-(position, head) scales — HBM only ever holds int8
+            k = k.astype(jnp.float32) * ksc_ref[0, 0][:, None]
+            v = v.astype(jnp.float32) * vsc_ref[0, 0][:, None]
+        s_ = jax.lax.dot_general(q, k.astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
+        col = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1)
+        s_ = jnp.where(col < length, s_, NEG_INF)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s_, axis=1, keepdims=True))
+        p = jnp.exp(s_ - m_new)
+        p = jnp.where(col < length, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[:] = m_new
+        pv = jax.lax.dot_general(p, v.astype(jnp.float32),
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * corr + pv
+
+    @pl.when(j == n_blocks - 1)
+    def _():
+        l = l_ref[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        # -inf on empty rows: the identity of the _merge_current fold
+        lse_ref[0] = jnp.where(l == 0.0, -jnp.inf,
+                               m_ref[:] + jnp.log(safe_l))
+
+
+def _paged_cost(s, h, d, kv_dtype, quantized, n_blocks_slot, block_size,
+                mean_context):
+    """``pl.CostEstimate`` for one paged decode call: the fetch-elided
+    HBM bytes at ``mean_context`` tokens of ACTUAL context per slot (the
+    index-map clamp makes repeated blocks free), so the pyprof roofline
+    prices what the kernel moves, not the worst-case table span."""
+    cap = n_blocks_slot * block_size
+    ctx = cap if mean_context is None else mean_context
+    ctx = float(min(max(ctx, 1), cap))
+    # fetched context rounds up to whole blocks per slot
+    ctx = math.ceil(ctx / block_size) * block_size
+    itemsize = jnp.dtype(kv_dtype).itemsize
+    kv_bytes = 2.0 * s * h * ctx * d * itemsize
+    if quantized:
+        kv_bytes += 2.0 * s * h * ctx * 4
+    io_bytes = kv_bytes + 2.0 * s * h * d * 4 + s * (n_blocks_slot + 1) * 4
+    flops = 4.0 * s * h * ctx * d          # qk^T + pv, 2 MACs each
+    return pl.CostEstimate(flops=int(flops), bytes_accessed=int(io_bytes),
+                           transcendentals=int(s * h * ctx))
+
+
+def _paged_decode_pallas(q, kp, vp, tables, lengths, ksc, vsc, *, scale,
+                         mean_context):
+    S, h, d = q.shape
+    _nb_pool, _, block_size, _ = kp.shape
+    n_blocks = tables.shape[1]
+    has_scale = ksc is not None
+
+    def q_map(s, hh, j, tabs, lens):
+        return (s, hh, 0)
+
+    def kv_map(s, hh, j, tabs, lens):
+        # clamp past-the-cursor steps to the slot's LAST valid block:
+        # equal consecutive indices elide the fetch, which is what
+        # bounds HBM traffic to the actual context. An empty slot
+        # (length 0) clamps to table entry 0 — the allocator's null
+        # block — and its compute is fully masked.
+        nb_valid = jnp.maximum(
+            (lens[s] + block_size - 1) // block_size, 1)
+        jj = jnp.minimum(j, nb_valid - 1)
+        return (tabs[s, jj], hh, 0, 0)
+
+    def sc_map(s, hh, j, tabs, lens):
+        nb_valid = jnp.maximum(
+            (lens[s] + block_size - 1) // block_size, 1)
+        jj = jnp.minimum(j, nb_valid - 1)
+        return (tabs[s, jj], hh, 0)
+
+    in_specs = [pl.BlockSpec((1, 1, d), q_map),
+                pl.BlockSpec((1, 1, block_size, d), kv_map),
+                pl.BlockSpec((1, 1, block_size, d), kv_map)]
+    args = [q, kp, vp]
+    if has_scale:
+        in_specs += [pl.BlockSpec((1, 1, block_size), sc_map),
+                     pl.BlockSpec((1, 1, block_size), sc_map)]
+        args += [ksc, vsc]
+
+    def kernel(*refs):
+        refs = list(refs)
+        tab_ref, len_ref, q_ref, k_ref, v_ref = refs[:5]
+        nxt = 5
+        ksc_ref = refs[nxt] if has_scale else None
+        vsc_ref = refs[nxt + 1] if has_scale else None
+        nxt += 2 * has_scale
+        o_ref, lse_ref, acc, m, l = refs[nxt:]
+        _paged_decode_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref,
+                             ksc_ref, vsc_ref, o_ref, lse_ref, acc, m, l,
+                             scale=scale, block_size=block_size,
+                             n_blocks=n_blocks)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, h, n_blocks),
+        in_specs=in_specs,
+        out_specs=(pl.BlockSpec((1, 1, d), q_map),
+                   pl.BlockSpec((1, 1, 1), q_map)),
+        scratch_shapes=[pltpu.VMEM((1, d), jnp.float32),
+                        pltpu.VMEM((1, 1), jnp.float32),
+                        pltpu.VMEM((1, 1), jnp.float32)])
+    out_dtype = q.dtype if q.dtype != jnp.int8 else jnp.float32
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=(jax.ShapeDtypeStruct((S, h, d), out_dtype),
+                   jax.ShapeDtypeStruct((S, h, 1), jnp.float32)),
+        cost_estimate=_paged_cost(S, h, d, kp.dtype, has_scale, n_blocks,
+                                  block_size, mean_context),
+        interpret=_interp(),
+        name="paged_decode_attention",
+    )(tables, lengths, *args)
+    return out, lse[..., 0]
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, lengths,
+                           k_new=None, v_new=None, k_scale=None,
+                           v_scale=None,
+                           softmax_scale: Optional[float] = None,
+                           mean_context: Optional[float] = None,
+                           use_pallas: Optional[bool] = None):
+    """Single-query attention over a PAGED KV cache (see the section
+    comment above) — the v2 serving decode kernel.
+
+    Args:
+      q: ``(b, h, d)`` — one query row per sequence slot.
+      k_pool, v_pool: ``(num_blocks, h, block_size, d)`` global block
+        pools (bf16/fp32, or int8 with pooled scales). Only the blocks a
+        slot's table names are ever read for it.
+      block_tables: ``(b, n_blocks_per_slot)`` int32 — pool indices of
+        each slot's logical blocks, in order. Entries past
+        ``ceil(length/block_size)`` are never read (the index map clamps
+        before them); unmapped entries should name the allocator's null
+        block (0).
+      lengths: ``(b,)`` int32 per-slot cursor — valid cache positions
+        (the current token is NOT in the cache; pass it via ``k_new``).
+      k_new, v_new: optional ``(b, h, d)`` current token, folded in with
+        the exact two-way LSE merge (empty prefix reduces to ``v_new``).
+      k_scale, v_scale: ``(num_blocks, h, block_size)`` fp32 pooled
+        dequantization scales, required iff the pool dtype is int8.
+      mean_context: expected ACTUAL context per slot (tokens), used only
+        to size the kernel's ``CostEstimate`` for the pyprof roofline —
+        never changes the math. Default: the worst-case table span.
+
+    Returns ``(b, h, d)`` in ``q.dtype``.
+
+    Falls back to a gather-then-reference XLA path (same math, priced
+    O(table span)) when the pool isn't tile-aligned for Pallas.
+    """
+    b, h, d = q.shape
+    nb_pool, hp, block_size, dp = k_pool.shape
+    if v_pool.shape != k_pool.shape or hp != h or dp != d:
+        raise ValueError(f"pool shapes {k_pool.shape}/{v_pool.shape} do "
+                         f"not match q {q.shape}")
+    if block_tables.ndim != 2 or block_tables.shape[0] != b:
+        raise ValueError(f"block_tables must be (b, n_blocks_per_slot), "
+                         f"got {block_tables.shape}")
+    quantized = k_pool.dtype == jnp.int8
+    if quantized and (k_scale is None or v_scale is None):
+        raise ValueError("int8 pools need k_scale/v_scale")
+    if softmax_scale is None:
+        softmax_scale = 1.0 / math.sqrt(d)
+    if use_pallas is None:
+        use_pallas = supports_paged(block_size, d)
+    elif use_pallas and not supports_paged(block_size, d):
+        raise ValueError(
+            f"use_pallas=True but block_size {block_size} / head_dim {d} "
+            "are not tile-aligned for the paged kernel; resize the pool "
+            "or let use_pallas auto-select the XLA fallback")
+    block_tables = jnp.asarray(block_tables).astype(jnp.int32)
+    lengths = jnp.asarray(lengths).astype(jnp.int32)
+
+    with jax.named_scope("decode_attention"):
+        if use_pallas:
+            out, lse = _paged_decode_pallas(
+                q, k_pool, v_pool, block_tables, lengths,
+                k_scale if quantized else None,
+                v_scale if quantized else None,
+                scale=float(softmax_scale), mean_context=mean_context)
+            if k_new is not None:
+                out = _merge_current(out, lse, q, k_new, v_new,
+                                     float(softmax_scale), q.dtype)
+            return out.astype(q.dtype)
+        # XLA fallback: gather the table-mapped blocks into the dense
+        # layout and run the dense fallback (one masked score pass +
+        # the same merge) — identical math, O(table span) traffic
+        T = block_tables.shape[1] * block_size
+        def gather(pool):
+            g = pool[block_tables]              # (b, nbs, h, bs, d)
+            return g.transpose(0, 2, 1, 3, 4).reshape(b, h, T, d)
+        kd = gather(k_pool)
+        vd = gather(v_pool)
+        ksc = vsc = None
+        if quantized:
+            def gather_sc(sc):
+                g = sc[block_tables]            # (b, nbs, h, bs)
+                return g.transpose(0, 2, 1, 3).reshape(b, h, T)
+            ksc = gather_sc(k_scale)
+            vsc = gather_sc(v_scale)
+        return decode_attention(q, kd, vd, lengths, k_new=k_new,
+                                v_new=v_new, k_scale=ksc, v_scale=vsc,
+                                softmax_scale=softmax_scale,
+                                use_pallas=False)

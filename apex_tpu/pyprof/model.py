@@ -432,6 +432,21 @@ def model_program(program, args: Optional[tuple] = None, *,
             elif name == "while":
                 note("while-loop body priced once (dynamic trip count)")
             elif name == "pallas_call":
+                est = eqn.params.get("cost_estimate")
+                if est is not None:
+                    # the kernel author's own CostEstimate beats the
+                    # body x grid heuristic — it can price data-bounded
+                    # grids (e.g. paged decode, whose index maps clamp
+                    # past-cursor steps so real traffic is O(actual
+                    # context), which body x grid cannot see)
+                    region = bucket(_region_of(stack, regions))
+                    region.flops += mult * float(
+                        getattr(est, "flops", 0) or 0)
+                    region.hbm_bytes += mult * float(
+                        getattr(est, "bytes_accessed", 0) or 0)
+                    note("pallas kernels with a CostEstimate priced "
+                         "from it")
+                    continue
                 grid = getattr(eqn.params.get("grid_mapping"), "grid", ())
                 for g in grid:
                     if isinstance(g, int):

@@ -16,14 +16,26 @@ The resilience layer (typed admission rejections, deadlines,
 poison-slot quarantine, graceful drain + zero-recompile hot weight
 swap, SLO brownout — docs/SERVING.md "Resilience") lives in
 :mod:`~apex_tpu.serving.resilience` plus scheduler/engine wiring.
+
+The paged layer (v2, docs/SERVING.md "Paged serving"): a global
+:class:`~apex_tpu.serving.cache.PagedKVCache` block pool with a
+host-side :class:`~apex_tpu.serving.cache.BlockAllocator` (refcounts,
+prefix-hash sharing, copy-on-write) driven by
+:class:`~apex_tpu.serving.engine.PagedServingEngine` — decode HBM
+traffic O(actual context) instead of O(max_len), admission reserves
+blocks instead of whole ``max_len`` slots, and shared prompt prefixes
+skip their prefill.
 """
 
 from apex_tpu.observability.reqtrace import (RequestRecord, RequestTrace,
                                              chrome_request_trace)
 from apex_tpu.observability.slo import (SLOTarget, SLOTracker,
                                         SLOViolationError)
-from apex_tpu.serving.cache import KVCache, cache_bytes_per_slot
-from apex_tpu.serving.engine import ServingEngine
+from apex_tpu.serving.cache import (AdmitPlan, BlockAllocator, KVCache,
+                                    PagedKVCache, PoolExhausted, StepPlan,
+                                    cache_bytes_per_slot,
+                                    paged_block_bytes)
+from apex_tpu.serving.engine import PagedServingEngine, ServingEngine
 from apex_tpu.serving.resilience import (REJECTION_REASONS,
                                          BrownoutPolicy,
                                          CheckpointWatcher, Rejection,
@@ -32,6 +44,8 @@ from apex_tpu.serving.sampling import sample_tokens
 from apex_tpu.serving.scheduler import Completion, Request, SlotScheduler
 
 __all__ = ["KVCache", "cache_bytes_per_slot", "ServingEngine",
+           "PagedKVCache", "BlockAllocator", "AdmitPlan", "StepPlan",
+           "PoolExhausted", "paged_block_bytes", "PagedServingEngine",
            "sample_tokens", "Completion", "Request", "SlotScheduler",
            "RequestRecord", "RequestTrace", "chrome_request_trace",
            "SLOTarget", "SLOTracker", "SLOViolationError",

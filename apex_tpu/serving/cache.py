@@ -26,17 +26,35 @@ time — every token is quantized against its own range, so there is no
 prefill-vs-decode calibration order to get wrong). HBM cost per token
 drops 2x vs bf16 at ~6% scale overhead; the decode kernel dequantizes
 blockwise in VMEM.
+
+**Paged layout (v2, docs/SERVING.md "Paged serving")**: the dense
+``(L, S, H, max_len, D)`` reservation pins max_len HBM per slot for its
+whole lifetime. :class:`PagedKVCache` replaces it with a global
+``(L, num_blocks, H, block_size, D)`` block POOL; which pool blocks a
+slot owns is host-side state in :class:`BlockAllocator` (per-slot int32
+block tables + cursors, refcounts, a chained prefix-hash index for
+copy-on-write prompt sharing). The device pytree holds ONLY the pool
+(+ scales) — tables and cursors ride as plain array arguments of the
+AOT serving programs, so admission, retirement, block growth, prefix
+sharing and COW are all zero-recompile by construction. Block index 0
+is the allocator's reserved NULL block: unmapped table entries and
+masked writes land there, keeping every device program total.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
-from typing import Optional, Tuple
+import hashlib
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-__all__ = ["KVCache", "cache_bytes_per_slot"]
+__all__ = ["KVCache", "cache_bytes_per_slot", "PagedKVCache",
+           "BlockAllocator", "AdmitPlan", "StepPlan", "PoolExhausted",
+           "paged_block_bytes"]
 
 # floor for the absmax quantization scale: keeps an all-zero row (e.g. a
 # never-written slot) from producing 0/0 at dequantization
@@ -147,36 +165,54 @@ class KVCache:
         an idle slot writes its garbage at a FROZEN cursor (overwritten
         by the next prefill) instead of creeping one position per step,
         which would otherwise grow every free slot's attention prefix
-        without bound. Slots already at ``max_len`` overwrite their last
-        position and stay saturated (the scheduler retires a sequence
-        before that matters). One batched dynamic_update_slice per
-        array — in-place on donated buffers."""
+        without bound. Slots already at ``max_len`` write NOTHING and
+        stay saturated: silently overwriting the last position (the v1
+        behavior) corrupted the newest KV entry of any sequence the
+        scheduler failed to retire in time — saturation is now loud at
+        the scheduler (retire-capacity before the step) and harmless
+        here (regression-tested in ``tests/test_serving.py``). One
+        batched dynamic_update_slice per array — in-place on donated
+        buffers."""
         pos = jnp.minimum(self.lengths, self.max_len - 1)
+        # saturated slots must NOT overwrite position max_len-1: write
+        # back the value already there (a no-op update keeps the one
+        # batched in-place DUS shape the donation contract relies on)
+        writable = self.lengths < self.max_len
+        L, H, D = self.num_layers, self.num_heads, self.head_dim
 
-        def upd(cache_s, new_s, p):
+        def upd(cache_s, new_s, p, w):
             # per-slot: (L, H, T, D) <- (L, H, 1, D) at position p
+            old = jax.lax.dynamic_slice(cache_s, (0, 0, p, 0),
+                                        (L, H, 1, D))
             return jax.lax.dynamic_update_slice(
-                cache_s, new_s[:, :, None, :], (0, 0, p, 0))
+                cache_s, jnp.where(w, new_s[:, :, None, :], old),
+                (0, 0, p, 0))
 
         kq, ks = self._store(k_new)
         vq, vs = self._store(v_new)
-        k = jax.vmap(upd, in_axes=(1, 1, 0), out_axes=1)(self.k, kq, pos)
-        v = jax.vmap(upd, in_axes=(1, 1, 0), out_axes=1)(self.v, vq, pos)
+        k = jax.vmap(upd, in_axes=(1, 1, 0, 0), out_axes=1)(
+            self.k, kq, pos, writable)
+        v = jax.vmap(upd, in_axes=(1, 1, 0, 0), out_axes=1)(
+            self.v, vq, pos, writable)
         advanced = jnp.minimum(self.lengths + 1, self.max_len)
         if active is not None:
             advanced = jnp.where(jnp.asarray(active, jnp.bool_),
                                  advanced, self.lengths)
         new = {"k": k, "v": v, "lengths": advanced}
         if self.quantized:
-            def upd_sc(sc_s, new_s, p):
+            def upd_sc(sc_s, new_s, p, w):
                 # per-slot: (L, H, T) <- (L, H, 1) at position p
+                old = jax.lax.dynamic_slice(sc_s, (0, 0, p), (L, H, 1))
                 return jax.lax.dynamic_update_slice(
-                    sc_s, new_s[:, :, None], (0, 0, p))
+                    sc_s, jnp.where(w, new_s[:, :, None], old),
+                    (0, 0, p))
 
-            new["k_scale"] = jax.vmap(upd_sc, in_axes=(1, 1, 0),
-                                      out_axes=1)(self.k_scale, ks, pos)
-            new["v_scale"] = jax.vmap(upd_sc, in_axes=(1, 1, 0),
-                                      out_axes=1)(self.v_scale, vs, pos)
+            new["k_scale"] = jax.vmap(upd_sc, in_axes=(1, 1, 0, 0),
+                                      out_axes=1)(self.k_scale, ks, pos,
+                                                  writable)
+            new["v_scale"] = jax.vmap(upd_sc, in_axes=(1, 1, 0, 0),
+                                      out_axes=1)(self.v_scale, vs, pos,
+                                                  writable)
         return dataclasses.replace(self, **new)
 
     def write_prompt(self, k_new: jnp.ndarray, v_new: jnp.ndarray,
@@ -213,3 +249,524 @@ def cache_bytes_per_slot(num_layers: int, num_heads: int, max_len: int,
     if jnp.dtype(dtype) == jnp.int8:
         per_pos += 2 * num_layers * num_heads * 4
     return per_pos * max_len
+
+
+def paged_block_bytes(num_layers: int, num_heads: int, block_size: int,
+                      head_dim: int, dtype=jnp.bfloat16) -> int:
+    """HBM bytes of ONE pool block (k + v across all layers, plus the
+    fp32 scales when int8) — the unit of the paged capacity math in
+    :meth:`apex_tpu.serving.engine.PagedServingEngine.suggest_pool_blocks`."""
+    return cache_bytes_per_slot(num_layers, num_heads, block_size,
+                                head_dim, dtype)
+
+
+# ---------------------------------------------------------------------------
+# paged layout: the device-side block pool
+# ---------------------------------------------------------------------------
+
+# the reserved null/garbage block: table entry 0 means "unmapped", and
+# every masked device write (inactive slot, saturated slot, prompt
+# padding past the last real block) is redirected at it — device
+# programs stay total and fixed-shape, the allocator simply never hands
+# block 0 out
+NULL_BLOCK = 0
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class PagedKVCache:
+    """The paged serving cache: a global block pool (see the module
+    docstring). Leaves: ``k``, ``v`` (+ ``k_scale``/``v_scale`` when
+    quantized) — per-slot block tables and cursors are HOST state
+    (:class:`BlockAllocator`) threaded into the AOT programs as plain
+    array arguments, never pytree leaves, so they are neither donated
+    nor shape-bearing."""
+
+    k: jnp.ndarray                       # (L, NB, H, block_size, D)
+    v: jnp.ndarray                       # (L, NB, H, block_size, D)
+    k_scale: Optional[jnp.ndarray] = None  # (L, NB, H, block_size) fp32
+    v_scale: Optional[jnp.ndarray] = None
+
+    # -- pytree protocol ----------------------------------------------------
+
+    def tree_flatten(self):
+        if self.quantized:
+            return ((self.k, self.v, self.k_scale, self.v_scale), True)
+        return ((self.k, self.v), False)
+
+    @classmethod
+    def tree_unflatten(cls, quantized, leaves):
+        if quantized:
+            return cls(*leaves)
+        k, v = leaves
+        return cls(k, v)
+
+    # -- shape/bookkeeping --------------------------------------------------
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def num_heads(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def head_dim(self) -> int:
+        return self.k.shape[4]
+
+    def nbytes(self) -> int:
+        """Total pool bytes (the number the paged capacity math sizes)."""
+        return sum(leaf.size * leaf.dtype.itemsize
+                   for leaf in self.tree_flatten()[0])
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def create(cls, num_layers: int, num_blocks: int, num_heads: int,
+               block_size: int, head_dim: int,
+               dtype=jnp.bfloat16) -> "PagedKVCache":
+        """Zero-filled pool. ``num_blocks`` INCLUDES the reserved null
+        block 0, so the allocatable capacity is ``num_blocks - 1``
+        blocks. ``dtype=jnp.int8`` enables the quantized layout."""
+        if num_blocks < 2:
+            raise ValueError(f"num_blocks must be >= 2 (block 0 is the "
+                             f"reserved null block), got {num_blocks}")
+        shape = (num_layers, num_blocks, num_heads, block_size, head_dim)
+        k = jnp.zeros(shape, dtype)
+        v = jnp.zeros(shape, dtype)
+        if jnp.dtype(dtype) == jnp.int8:
+            # two DISTINCT scale buffers — see KVCache.create
+            return cls(k, v,
+                       jnp.full(shape[:-1], _MIN_SCALE, jnp.float32),
+                       jnp.full(shape[:-1], _MIN_SCALE, jnp.float32))
+        return cls(k, v)
+
+    # -- writes (device-side, inside the AOT programs) ----------------------
+
+    def _store(self, x: jnp.ndarray):
+        if self.quantized:
+            return _quantize(x)
+        return x.astype(self.k.dtype), None
+
+    def append(self, k_new: jnp.ndarray, v_new: jnp.ndarray,
+               block_ids: jnp.ndarray,
+               offsets: jnp.ndarray) -> "PagedKVCache":
+        """Append one token per slot: ``k_new``/``v_new`` are
+        ``(L, S, H, D)``, ``block_ids``/``offsets`` ``(S,)`` int32 name
+        the pool block and in-block position each slot writes (the HOST
+        computes them from its cursor mirror; masked slots point at the
+        null block). One batched scatter per array — in-place on donated
+        buffers (asserted by the engine's donation lint)."""
+        kq, ks = self._store(k_new)
+        vq, vs = self._store(v_new)
+        # two advanced indices split by slices -> update dims lead: (S, L, H, D)
+        k = self.k.at[:, block_ids, :, offsets, :].set(
+            jnp.transpose(kq, (1, 0, 2, 3)), mode="drop")
+        v = self.v.at[:, block_ids, :, offsets, :].set(
+            jnp.transpose(vq, (1, 0, 2, 3)), mode="drop")
+        new = {"k": k, "v": v}
+        if self.quantized:
+            new["k_scale"] = self.k_scale.at[:, block_ids, :, offsets].set(
+                jnp.transpose(ks, (1, 0, 2)), mode="drop")
+            new["v_scale"] = self.v_scale.at[:, block_ids, :, offsets].set(
+                jnp.transpose(vs, (1, 0, 2)), mode="drop")
+        return dataclasses.replace(self, **new)
+
+    def write_prompt_blocks(self, k_new: jnp.ndarray, v_new: jnp.ndarray,
+                            block_row: jnp.ndarray) -> "PagedKVCache":
+        """Prefill write: ``k_new``/``v_new`` are ``(L, H, P, D)`` for
+        ONE slot with ``P`` a multiple of ``block_size``; ``block_row``
+        ``(P // block_size,)`` int32 names the destination pool block of
+        each prompt chunk (null entries absorb the padding past the last
+        real block). Positions past the true prompt length hold padding
+        garbage — the cursor masks them from every read."""
+        L, H, P, D = k_new.shape
+        bs = self.block_size
+        npb = P // bs
+        if npb * bs != P:
+            raise ValueError(f"prompt window {P} must be a multiple of "
+                             f"block_size {bs}")
+
+        def scatter(pool, x):
+            # (L, H, P, D) -> (L, NPB, H, bs, D): one advanced index at
+            # axis 1 keeps its position, so the update leads with L
+            blocks = x.reshape(L, H, npb, bs, D).transpose(0, 2, 1, 3, 4)
+            return pool.at[:, block_row].set(blocks, mode="drop")
+
+        kq, ks = self._store(k_new)
+        vq, vs = self._store(v_new)
+        new = {"k": scatter(self.k, kq), "v": scatter(self.v, vq)}
+        if self.quantized:
+            def scatter_sc(pool, sc):
+                blocks = sc.reshape(L, H, npb, bs).transpose(0, 2, 1, 3)
+                return pool.at[:, block_row].set(blocks, mode="drop")
+            new["k_scale"] = scatter_sc(self.k_scale, ks)
+            new["v_scale"] = scatter_sc(self.v_scale, vs)
+        return dataclasses.replace(self, **new)
+
+    def cow_copy(self, src: jnp.ndarray, dst: jnp.ndarray) -> "PagedKVCache":
+        """Copy-on-write resolution: pool block ``dst[s] <- src[s]`` per
+        slot, BEFORE this step's reads and append (the caller sequences
+        it first). The null no-op is ``src == dst == 0`` — block 0 onto
+        itself — so a step with no pending COW runs the identical
+        program (zero-recompile across admit/COW/retire)."""
+        def copy(pool):
+            return pool.at[:, dst].set(pool[:, src], mode="drop")
+        new = {"k": copy(self.k), "v": copy(self.v)}
+        if self.quantized:
+            new["k_scale"] = copy(self.k_scale)
+            new["v_scale"] = copy(self.v_scale)
+        return dataclasses.replace(self, **new)
+
+
+# ---------------------------------------------------------------------------
+# host-side block allocator: refcounts, prefix hashing, copy-on-write
+# ---------------------------------------------------------------------------
+
+
+class PoolExhausted(RuntimeError):
+    """No allocatable pool block (free list empty, nothing evictable)."""
+
+
+@dataclasses.dataclass
+class AdmitPlan:
+    """What :meth:`BlockAllocator.admit` decided for one admission.
+
+    ``shared_tokens > 0`` means a prefix hit: the first
+    ``shared_tokens`` positions are already in mapped (refcounted)
+    shared blocks and the engine must run ONLY ``suffix`` through the
+    decode program — the TTFT win. ``prefill=True`` is the cold path:
+    run the full prefill program into ``block_row``."""
+
+    slot: int
+    prompt_len: int
+    prefill: bool
+    block_row: List[int]        # prefill destinations (cold path only)
+    shared_tokens: int = 0
+    suffix: Tuple[int, ...] = ()
+    cow_pending: bool = False   # the last shared block awaits COW
+
+
+@dataclasses.dataclass
+class StepPlan:
+    """Per-decode-step device arguments from
+    :meth:`BlockAllocator.prepare_step`: the COW copy pairs (null
+    no-ops when nothing is pending) and the slots that could NOT be
+    given a block to write (pool exhausted) — the scheduler retires
+    those loudly instead of letting a write silently drop."""
+
+    cow_src: np.ndarray         # (S,) int32
+    cow_dst: np.ndarray         # (S,) int32
+    failed: List[int]
+
+
+class BlockAllocator:
+    """Host-side bookkeeping for a :class:`PagedKVCache` (see the module
+    docstring): the free list, per-block refcounts, per-slot block
+    tables + cursors (the mirrors threaded into the AOT programs), the
+    chained prefix-hash index, and lazily-resolved copy-on-write.
+
+    Prefix sharing: a COLD admission registers each FULL prompt block
+    under a chained hash (block i's key digests block i-1's key plus
+    the chunk's tokens, so a hit at depth i certifies the whole prefix).
+    A later admission walks the chain; hits map the shared blocks into
+    its table (refcount++) and skip prefill for the shared span. Hash
+    collisions cannot serve wrong KV: every index entry stores its
+    exact token chunk and a mismatch falls back to the cold path
+    (tested in ``tests/test_paged.py``). Retired blocks whose content
+    is still registered park in an LRU "cached" pool (refcount 0, not
+    yet freed) so a follow-up admission with the same prefix still
+    hits; allocation pressure evicts them oldest-first.
+
+    Copy-on-write: when a hit covers the WHOLE prompt, the admission
+    maps the final shared block but must write its own KV into it (the
+    last prompt position belongs to this request's divergence point) —
+    the block is marked COW-pending and the next
+    :meth:`prepare_step` that sees the slot's cursor inside it
+    allocates a private copy target; the device copies before it
+    writes. Writes into fully-shared spans never happen (appends past
+    the shared span land in freshly-owned blocks), so this lazy single
+    pending block is the complete COW story."""
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 blocks_per_slot: int, max_seqs: int):
+        if num_blocks < 2:
+            raise ValueError(f"num_blocks must be >= 2, got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.blocks_per_slot = int(blocks_per_slot)
+        self.max_seqs = int(max_seqs)
+        # LIFO free list; block 0 is the reserved null block
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self.refcount = np.zeros(num_blocks, np.int32)
+        self.refcount[NULL_BLOCK] = 1           # pinned forever
+        self.tables = np.zeros((max_seqs, blocks_per_slot), np.int32)
+        self.lengths = np.zeros(max_seqs, np.int32)
+        # prefix index: chain digest -> (block, parent digest, chunk)
+        self._index: Dict[bytes, Tuple[int, Optional[bytes],
+                                       Tuple[int, ...]]] = {}
+        self._block_key: Dict[int, bytes] = {}
+        # refcount-0 blocks still registered: evictable LRU
+        self._cached: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+        self._cow_pending: Dict[int, int] = {}   # slot -> table index
+        # monotonic host counters the scheduler snapshots into serve/*
+        self.cow_copies = 0
+        self.prefix_hits = 0
+        self.prefix_hit_tokens = 0
+
+    # -- capacity -----------------------------------------------------------
+
+    @property
+    def free_blocks(self) -> int:
+        """Immediately allocatable blocks (free + evictable cached)."""
+        return len(self._free) + len(self._cached)
+
+    @property
+    def capacity_tokens(self) -> int:
+        """Per-slot token capacity (the table width in tokens)."""
+        return self.blocks_per_slot * self.block_size
+
+    def blocks_for(self, n_tokens: int) -> int:
+        return -(-int(n_tokens) // self.block_size)
+
+    # -- low-level block lifecycle ------------------------------------------
+
+    def _evict_one(self) -> int:
+        block, _ = self._cached.popitem(last=False)   # oldest first
+        self._unregister(block)
+        return block
+
+    def _take_block(self) -> int:
+        if self._free:
+            return self._free.pop()
+        if self._cached:
+            return self._evict_one()
+        raise PoolExhausted(
+            f"block pool exhausted: {self.num_blocks - 1} allocatable "
+            "blocks all referenced")
+
+    def _unregister(self, block: int) -> None:
+        key = self._block_key.pop(block, None)
+        if key is not None and self._index.get(key, (None,))[0] == block:
+            del self._index[key]
+
+    def _release_block(self, block: int) -> None:
+        if block == NULL_BLOCK:
+            return
+        self.refcount[block] -= 1
+        if self.refcount[block] > 0:
+            return
+        if block in self._block_key:
+            # content still registered: park it for prefix reuse
+            self._cached[block] = None
+        else:
+            self._free.append(block)
+
+    def _revive(self, block: int) -> None:
+        """refcount 0 -> 1 on a cached (registered, unowned) block."""
+        if self.refcount[block] == 0:
+            self._cached.pop(block, None)
+        self.refcount[block] += 1
+
+    # -- prefix hashing ------------------------------------------------------
+
+    @staticmethod
+    def _digest(parent: Optional[bytes],
+                chunk: Sequence[int]) -> bytes:
+        h = hashlib.sha256(parent or b"")
+        h.update(np.asarray(chunk, np.int64).tobytes())
+        return h.digest()
+
+    def _chain(self, prompt: Sequence[int]):
+        """(digest, chunk) per FULL block of ``prompt``, chained."""
+        bs = self.block_size
+        out = []
+        parent: Optional[bytes] = None
+        for i in range(len(prompt) // bs):
+            chunk = tuple(int(t) for t in prompt[i * bs:(i + 1) * bs])
+            digest = self._digest(parent, chunk)
+            out.append((digest, chunk))
+            parent = digest
+        return out
+
+    def lookup(self, prompt: Sequence[int]) -> List[int]:
+        """Longest verified chain of live shared blocks covering
+        ``prompt``'s full-block prefix. Verification compares the STORED
+        token chunk, so a digest collision reads as a miss (falls back
+        to full prefill — never serves wrong KV)."""
+        blocks: List[int] = []
+        for digest, chunk in self._chain(prompt):
+            entry = self._index.get(digest)
+            if entry is None or entry[2] != chunk:
+                break
+            blocks.append(entry[0])
+        return blocks
+
+    # -- admission / registration / release ---------------------------------
+
+    def admit(self, slot: int, prompt: Sequence[int],
+              prefill_blocks: int, share: bool = True) -> AdmitPlan:
+        """Map ``slot``'s table for ``prompt`` and return the plan.
+
+        ``prefill_blocks`` is the engine's static prompt window in
+        blocks — the cold path allocates only ``ceil(P/block_size)``
+        real blocks and pads the row with nulls. ``share=False`` forces
+        the cold path even on a prefix hit (the engine's
+        ``prefix_suffix_cap`` policy). Raises :class:`PoolExhausted`
+        when the blocks aren't there (admission control queues on
+        that); every partial allocation is rolled back first."""
+        P = len(prompt)
+        if not 0 <= slot < self.max_seqs:
+            raise ValueError(f"slot {slot} out of range "
+                             f"[0, {self.max_seqs})")
+        if P > self.capacity_tokens:
+            raise ValueError(f"prompt length {P} exceeds the per-slot "
+                             f"capacity {self.capacity_tokens}")
+        if np.any(self.tables[slot] != NULL_BLOCK) or self.lengths[slot]:
+            raise ValueError(f"slot {slot} still holds blocks — release "
+                             "it before re-admitting")
+        shared = self.lookup(prompt) if share else []
+        if shared:
+            n_shared = len(shared)
+            covers_all = n_shared * self.block_size >= P
+            # the LAST prompt position is this request's divergence
+            # point: it must be decoded (it samples the first token)
+            # and its KV written — never shared
+            shared_tokens = (P - 1 if covers_all
+                             else n_shared * self.block_size)
+            for b in shared:
+                self._revive(b)
+            self.tables[slot, :n_shared] = shared
+            self.lengths[slot] = shared_tokens
+            if covers_all:
+                # the write at P-1 lands INSIDE the final shared block:
+                # copy-on-write, resolved lazily at the next step
+                self._cow_pending[slot] = n_shared - 1
+            self.prefix_hits += 1
+            self.prefix_hit_tokens += int(shared_tokens)
+            return AdmitPlan(slot, P, prefill=False, block_row=[],
+                             shared_tokens=int(shared_tokens),
+                             suffix=tuple(int(t)
+                                          for t in prompt[shared_tokens:]),
+                             cow_pending=covers_all)
+        # cold path: real blocks for the prompt, nulls for the padding
+        n_real = self.blocks_for(P)
+        row: List[int] = []
+        try:
+            for _ in range(n_real):
+                row.append(self._take_block())
+        except PoolExhausted:
+            for b in row:
+                self._free.append(b)
+            raise
+        for b in row:
+            self.refcount[b] = 1
+        self.tables[slot, :n_real] = row
+        self.lengths[slot] = P
+        return AdmitPlan(slot, P, prefill=True,
+                         block_row=row + [NULL_BLOCK] *
+                         (prefill_blocks - n_real))
+
+    def register_prefix(self, slot: int, prompt: Sequence[int]) -> None:
+        """After a COLD prefill lands: index ``slot``'s full prompt
+        blocks under their chain digests so later admissions can share
+        them. Existing registrations win (their block is already
+        shared-ready); a block never re-registers under a second key."""
+        for i, (digest, chunk) in enumerate(self._chain(prompt)):
+            block = int(self.tables[slot, i])
+            if block == NULL_BLOCK or block in self._block_key:
+                continue
+            if digest in self._index:
+                continue
+            self._index[digest] = (block, None, chunk)
+            self._block_key[block] = digest
+
+    def release(self, slot: int) -> None:
+        """Retire ``slot``: every mapped block drops a reference
+        (registered blocks park in the prefix cache at refcount 0,
+        unregistered ones free immediately); table and cursor zero."""
+        for b in self.tables[slot]:
+            self._release_block(int(b))
+        self.tables[slot] = NULL_BLOCK
+        self.lengths[slot] = 0
+        self._cow_pending.pop(slot, None)
+
+    # -- per-step device arguments ------------------------------------------
+
+    def append_targets(self, active: np.ndarray):
+        """``(block_ids, offsets)`` ``(S,)`` int32 for this step's
+        append: each ACTIVE slot writes at its cursor; inactive or
+        saturated slots aim at the null block."""
+        cur = self.lengths
+        bidx = np.minimum(cur // self.block_size,
+                          self.blocks_per_slot - 1)
+        bid = self.tables[np.arange(self.max_seqs), bidx].copy()
+        ok = np.asarray(active, bool) & (cur < self.capacity_tokens)
+        bid[~ok] = NULL_BLOCK
+        return bid.astype(np.int32), (cur % self.block_size).astype(
+            np.int32)
+
+    def prepare_step(self, active_slots: Sequence[int]) -> StepPlan:
+        """Make every active slot writable for ONE append: resolve any
+        COW whose block the cursor is about to enter (allocate the
+        private copy, swap the table entry, emit the device copy pair)
+        and allocate a fresh block where the cursor crossed into an
+        unmapped table entry. Slots the pool cannot serve land in
+        ``failed`` — the scheduler retires them loudly."""
+        cow_src = np.zeros(self.max_seqs, np.int32)
+        cow_dst = np.zeros(self.max_seqs, np.int32)
+        failed: List[int] = []
+        for slot in active_slots:
+            cur = int(self.lengths[slot])
+            if cur >= self.capacity_tokens:
+                failed.append(slot)
+                continue
+            bidx = cur // self.block_size
+            pend = self._cow_pending.get(slot)
+            if pend is not None and pend == bidx:
+                old = int(self.tables[slot, bidx])
+                try:
+                    new = self._take_block()
+                except PoolExhausted:
+                    failed.append(slot)
+                    continue
+                self.refcount[new] = 1
+                self.tables[slot, bidx] = new
+                cow_src[slot] = old
+                cow_dst[slot] = new
+                # the device copies old -> new THIS step before any
+                # write; dropping the reference now is safe because the
+                # content survives in the still-live readers' mapping
+                self._release_block(old)
+                del self._cow_pending[slot]
+                self.cow_copies += 1
+                continue
+            if self.tables[slot, bidx] == NULL_BLOCK:
+                try:
+                    new = self._take_block()
+                except PoolExhausted:
+                    failed.append(slot)
+                    continue
+                self.refcount[new] = 1
+                self.tables[slot, bidx] = new
+        return StepPlan(cow_src, cow_dst, failed)
+
+    def advance(self, slots: Sequence[int]) -> None:
+        """Cursor mirror +1 for the slots whose append just landed."""
+        for slot in slots:
+            self.lengths[slot] = min(int(self.lengths[slot]) + 1,
+                                     self.capacity_tokens)

@@ -42,10 +42,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from apex_tpu.observability.costs import memory_budget
-from apex_tpu.serving.cache import KVCache, cache_bytes_per_slot
+from apex_tpu.serving.cache import (KVCache, PagedKVCache, BlockAllocator,
+                                    AdmitPlan, PoolExhausted,
+                                    cache_bytes_per_slot, paged_block_bytes)
 from apex_tpu.serving.sampling import sample_tokens
 
-__all__ = ["ServingEngine"]
+__all__ = ["ServingEngine", "PagedServingEngine"]
 
 
 class ServingEngine:
@@ -358,3 +360,337 @@ class ServingEngine:
                 for leaf in jax.tree_util.tree_leaves(self.params))
         avail = int(hbm_bytes * (1.0 - reserve_fraction)) - overhead
         return max(0, avail // self.bytes_per_slot())
+
+
+class PagedServingEngine(ServingEngine):
+    """The v2 paged engine: same three-AOT-program contract as
+    :class:`ServingEngine` (compiled once at construction, cache
+    donated, ``lint_serving_engine`` self-check, zero recompiles across
+    admit/COW/retire), but the cache is a global
+    :class:`~apex_tpu.serving.cache.PagedKVCache` block pool — a slot
+    reserves ``ceil(context/block_size)`` blocks instead of ``max_len``
+    positions, the decode step's HBM traffic is O(actual context)
+    (``paged_decode_attention``), and admissions whose prompt prefix is
+    already pooled SHARE those blocks and skip prefill for the shared
+    span (copy-on-write; the TTFT win ``serve/ttft_prefix_ms`` tracks).
+
+    Host state (block tables, cursors, refcounts, the prefix-hash
+    index) lives in :attr:`allocator` — a
+    :class:`~apex_tpu.serving.cache.BlockAllocator` — and rides into
+    the fixed-shape programs as plain array arguments, so per-request
+    bookkeeping never retraces anything.
+
+    Extra construction knobs vs the dense engine:
+
+    Args:
+      num_blocks: global pool size in blocks (block 0 is the reserved
+        null block — allocatable capacity is ``num_blocks - 1``). Size
+        with :meth:`suggest_pool_blocks`.
+      block_size: tokens per block. On TPU the paged Pallas kernel
+        wants ``block_size % 128 == 0``; any size works via the XLA
+        fallback (and under interpret mode on CPU).
+      prefix_suffix_cap: longest un-shared prompt TAIL (tokens) worth
+        serving through per-token decode steps on a prefix hit; a hit
+        whose tail is longer falls back to the cold full prefill
+        (sequential decode would beat one batched prefill only near
+        full coverage). Default: ``block_size``.
+    """
+
+    def __init__(self, model, params, *, max_seqs: int, max_len: int,
+                 prefill_len: int, num_blocks: int, block_size: int,
+                 cache_dtype=jnp.bfloat16, top_k: int = 0,
+                 rng_seed: int = 0, quarantine: bool = False,
+                 prefix_suffix_cap: Optional[int] = None,
+                 mean_context: Optional[float] = None):
+        model._require_cacheable()
+        cfg = model.cfg
+        if max_len > cfg.max_position_embeddings:
+            raise ValueError(
+                f"max_len {max_len} exceeds max_position_embeddings "
+                f"{cfg.max_position_embeddings}")
+        if prefill_len > max_len:
+            raise ValueError(f"prefill_len {prefill_len} exceeds max_len "
+                             f"{max_len}")
+        if prefill_len % block_size != 0:
+            raise ValueError(
+                f"prefill_len {prefill_len} must be a multiple of "
+                f"block_size {block_size} (the prefill program writes "
+                "whole pool blocks)")
+        self.model = model
+        self.params = params
+        self.max_seqs = int(max_seqs)
+        self.max_len = int(max_len)
+        self.prefill_len = int(prefill_len)
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.top_k = int(top_k)
+        self.quarantine = bool(quarantine)
+        self.prefix_suffix_cap = int(block_size if prefix_suffix_cap
+                                     is None else prefix_suffix_cap)
+        self.mean_context = mean_context
+        self.last_finite: Optional[np.ndarray] = None
+        self.last_admit: Optional[AdmitPlan] = None
+        self.last_failed: list = []
+        self.swaps = 0
+        self.prefill_blocks = self.prefill_len // self.block_size
+        blocks_per_slot = -(-self.max_len // self.block_size)
+        self.cache = PagedKVCache.create(
+            cfg.num_layers, num_blocks, cfg.num_attention_heads,
+            block_size, cfg.head_dim, dtype=cache_dtype)
+        self.allocator = BlockAllocator(num_blocks, block_size,
+                                        blocks_per_slot, max_seqs)
+
+        def prefill_step(params, cache, tokens, block_row, true_len,
+                         temperature, rng):
+            with jax.named_scope("serve_prefill"):
+                logits, cache = model.forward(params, tokens,
+                                              kv_cache=cache,
+                                              block_row=block_row,
+                                              prompt_len=true_len,
+                                              last_logit_only=True)
+                tok = sample_tokens(logits[0], rng, temperature[None],
+                                    self.top_k)[0]
+            return cache, tok
+
+        mc = self.mean_context
+
+        def _decode_core(params, cache, tables, lengths, tokens,
+                         temperature, block_ids, offsets, cow_src,
+                         cow_dst, rng, poison=None):
+            logits, cache = model.forward(
+                params, tokens[:, None], kv_cache=cache,
+                block_tables=tables, lengths=lengths,
+                append_block_ids=block_ids, append_offsets=offsets,
+                cow_src=cow_src, cow_dst=cow_dst, mean_context=mc)
+            if poison is not None:
+                logits = logits + poison[:, None]
+                finite = jnp.all(jnp.isfinite(logits), axis=-1)
+                toks = sample_tokens(logits, rng, temperature,
+                                     self.top_k)
+                return cache, toks, finite
+            toks = sample_tokens(logits, rng, temperature, self.top_k)
+            return cache, toks
+
+        if self.quarantine:
+            def decode_step(params, cache, tables, lengths, tokens,
+                            temperature, block_ids, offsets, cow_src,
+                            cow_dst, rng, poison):
+                with jax.named_scope("serve_decode"):
+                    return _decode_core(params, cache, tables, lengths,
+                                        tokens, temperature, block_ids,
+                                        offsets, cow_src, cow_dst, rng,
+                                        poison)
+        else:
+            def decode_step(params, cache, tables, lengths, tokens,
+                            temperature, block_ids, offsets, cow_src,
+                            cow_dst, rng):
+                with jax.named_scope("serve_decode"):
+                    return _decode_core(params, cache, tables, lengths,
+                                        tokens, temperature, block_ids,
+                                        offsets, cow_src, cow_dst, rng)
+
+        key = jax.random.PRNGKey(rng_seed)
+        self._key, _ = jax.random.split(key)
+        S = self.max_seqs
+        ex_tokens = jnp.zeros((1, self.prefill_len), jnp.int32)
+        ex_row = jnp.zeros((self.prefill_blocks,), jnp.int32)
+        ex_scalar = jnp.zeros((), jnp.int32)
+        ex_temp = jnp.zeros((), jnp.float32)
+        self.prefill_traced = jax.jit(
+            prefill_step, donate_argnums=(1,)).trace(
+                params, self.cache, ex_tokens, ex_row, ex_scalar,
+                ex_temp, self._key)
+        self.prefill_compiled = self.prefill_traced.lower().compile()
+        self._zero_poison = jnp.zeros((S,), jnp.float32)
+        zs = jnp.zeros((S,), jnp.int32)
+        decode_args = (params, self.cache,
+                       jnp.zeros((S, blocks_per_slot), jnp.int32), zs,
+                       zs, jnp.zeros((S,), jnp.float32), zs, zs, zs, zs,
+                       self._key)
+        if self.quarantine:
+            decode_args += (self._zero_poison,)
+        self.decode_traced = jax.jit(
+            decode_step, donate_argnums=(1,)).trace(*decode_args)
+        self.decode_compiled = self.decode_traced.lower().compile()
+
+        def release_step(cache):
+            # re-zero the reserved null block: every masked write
+            # (inactive slot, saturated slot, prompt padding) lands in
+            # it, so a retire is the natural point to scrub the garbage
+            # back to the "reads as zeros" invariant. Real in-place
+            # writes on every donated leaf — the donation lint holds.
+            from apex_tpu.serving.cache import NULL_BLOCK, _MIN_SCALE
+            new = {"k": cache.k.at[:, NULL_BLOCK].set(0),
+                   "v": cache.v.at[:, NULL_BLOCK].set(0)}
+            if cache.quantized:
+                new["k_scale"] = cache.k_scale.at[:, NULL_BLOCK].set(
+                    jnp.float32(_MIN_SCALE))
+                new["v_scale"] = cache.v_scale.at[:, NULL_BLOCK].set(
+                    jnp.float32(_MIN_SCALE))
+            return dataclasses.replace(cache, **new)
+
+        self.release_compiled = jax.jit(
+            release_step, donate_argnums=(0,)).trace(
+                self.cache).lower().compile()
+
+        from apex_tpu.analysis.program import (lint_serving_engine,
+                                               verify_findings)
+        verify_findings(lint_serving_engine(self),
+                        "PagedServingEngine construction")
+
+    # -- admission ----------------------------------------------------------
+
+    def can_admit(self, prompt: Sequence[int]) -> bool:
+        """Whether the pool can take ``prompt`` right now (conservative:
+        assumes a cold admission; a prefix hit needs fewer blocks)."""
+        return (self.allocator.free_blocks
+                >= self.allocator.blocks_for(len(prompt)))
+
+    def prefill(self, prompt: Sequence[int], slot: int,
+                temperature: float = 0.0) -> int:
+        """Admit ``prompt`` into ``slot`` and return the first sampled
+        token. Two paths, chosen by the allocator's prefix index:
+
+        - **cold**: allocate blocks, run the batched prefill program.
+        - **prefix hit** (tail within ``prefix_suffix_cap``): map the
+          shared blocks (refcount++), skip prefill for the shared span,
+          and feed ONLY the un-shared tail through the decode program
+          one token at a time (``active`` = this slot alone — other
+          slots' cursors and blocks are untouched). The final step's
+          sample is the first token.
+
+        Raises :class:`~apex_tpu.serving.cache.PoolExhausted` when the
+        blocks aren't there — the scheduler queues on that (typed
+        :class:`~apex_tpu.serving.resilience.Rejection` at submit).
+        Sets :attr:`last_admit` to the chosen
+        :class:`~apex_tpu.serving.cache.AdmitPlan` for the scheduler's
+        prefix metrics."""
+        if not 0 <= int(slot) < self.max_seqs:
+            raise ValueError(f"slot {slot} out of range "
+                             f"[0, {self.max_seqs})")
+        prompt = [int(t) for t in prompt]
+        shared = self.allocator.lookup(prompt)
+        covered = min(len(shared) * self.block_size, len(prompt) - 1)
+        if shared and len(prompt) - covered > self.prefix_suffix_cap:
+            shared = []        # tail too long: cold prefill wins
+        if not shared:
+            plan = self.allocator.admit(slot, prompt,
+                                        self.prefill_blocks,
+                                        share=False)
+        else:
+            plan = self.allocator.admit(slot, prompt,
+                                        self.prefill_blocks)
+        self.last_admit = plan
+        if plan.prefill:
+            self.cache, tok = self.prefill_compiled(
+                self.params, self.cache, self.pad_prompt(prompt),
+                jnp.asarray(np.asarray(plan.block_row, np.int32)),
+                jnp.asarray(len(prompt), jnp.int32),
+                jnp.asarray(temperature, jnp.float32), self._next_key())
+            # index the freshly written full blocks so LATER admissions
+            # can share them
+            self.allocator.register_prefix(slot, prompt)
+            return int(tok)
+        # prefix hit: decode the un-shared tail token by token through
+        # the ordinary decode program (same compiled program — zero
+        # recompiles), other slots frozen
+        active = np.zeros(self.max_seqs, np.bool_)
+        active[slot] = True
+        tokens = np.zeros(self.max_seqs, np.int32)
+        temps = np.zeros(self.max_seqs, np.float32)
+        temps[slot] = temperature
+        tok = 0
+        for t in plan.suffix:
+            tokens[slot] = t
+            toks = self.decode(tokens, temps, active=active)
+            tok = int(toks[slot])
+        return tok
+
+    # -- stepping -----------------------------------------------------------
+
+    def decode(self, tokens: np.ndarray, temperatures: np.ndarray,
+               active: Optional[np.ndarray] = None,
+               poison: Optional[np.ndarray] = None) -> np.ndarray:
+        """One decode step for every slot (same call contract as
+        :meth:`ServingEngine.decode`). Per-step block bookkeeping
+        happens HERE: pending copy-on-writes are resolved (the device
+        copies the block before writing it), cursors that crossed a
+        block boundary get a fresh block, and slots the exhausted pool
+        could not serve land in :attr:`last_failed` — their append is
+        dropped (null block) and the scheduler retires them loudly."""
+        if active is None:
+            active = np.ones(self.max_seqs, np.bool_)
+        active = np.asarray(active, bool)
+        step = self.allocator.prepare_step(list(np.flatnonzero(active)))
+        self.last_failed = list(step.failed)
+        ok = active.copy()
+        ok[step.failed] = False
+        block_ids, offsets = self.allocator.append_targets(ok)
+        args = (self.params, self.cache,
+                jnp.asarray(self.allocator.tables),
+                jnp.asarray(self.allocator.lengths),
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(temperatures, jnp.float32),
+                jnp.asarray(block_ids), jnp.asarray(offsets),
+                jnp.asarray(step.cow_src), jnp.asarray(step.cow_dst),
+                self._next_key())
+        if self.quarantine:
+            pvec = self._zero_poison if poison is None else \
+                jnp.asarray(poison, jnp.float32)
+            self.cache, toks, finite = self.decode_compiled(*args, pvec)
+            self.last_finite = np.asarray(finite)
+        else:
+            if poison is not None:
+                raise ValueError(
+                    "poison injection requires a quarantine engine "
+                    "(PagedServingEngine(..., quarantine=True)) — on a "
+                    "plain engine the fault would be silently dropped")
+            self.cache, toks = self.decode_compiled(*args)
+        self.allocator.advance(list(np.flatnonzero(ok)))
+        return np.asarray(toks)
+
+    def release_slot(self, slot: int) -> None:
+        """Retire ``slot``: drop its block references on the host
+        (shared blocks survive for their other readers — and for the
+        prefix cache) and scrub the null block on device."""
+        if not 0 <= int(slot) < self.max_seqs:
+            raise ValueError(f"slot {slot} out of range "
+                             f"[0, {self.max_seqs})")
+        self.allocator.release(slot)
+        self.cache = self.release_compiled(self.cache)
+
+    # -- capacity -----------------------------------------------------------
+
+    def block_bytes(self) -> int:
+        cfg = self.model.cfg
+        return paged_block_bytes(cfg.num_layers, cfg.num_attention_heads,
+                                 self.block_size, cfg.head_dim,
+                                 self.cache.k.dtype)
+
+    def suggest_pool_blocks(self, hbm_bytes: int, mean_len: float,
+                            reserve_fraction: float = 0.1) -> int:
+        """Pool blocks that fit ``hbm_bytes`` — the paged successor of
+        :meth:`ServingEngine.suggest_max_seqs`. The compiled step's
+        non-cache footprint is measured and subtracted (params, logits,
+        temporaries), a ``reserve_fraction`` margin held back, and the
+        rest divided by the per-block bytes. The mean-length capacity
+        math reads off it: a pool of ``B`` blocks sustains about
+        ``B * block_size / mean_len`` concurrent sequences — versus the
+        dense engine's hard ``HBM / (max_len bytes-per-slot)`` ceiling,
+        a ``max_len / mean_len`` capacity win at the same HBM."""
+        if mean_len <= 0:
+            raise ValueError(f"mean_len must be positive, got {mean_len}")
+        overhead = self.overhead_bytes()
+        if overhead is None:
+            overhead = sum(
+                leaf.size * leaf.dtype.itemsize
+                for leaf in jax.tree_util.tree_leaves(self.params))
+        avail = int(hbm_bytes * (1.0 - reserve_fraction)) - overhead
+        return max(0, avail // self.block_bytes())
+
+    def suggest_max_seqs_for_pool(self, num_blocks: int,
+                                  mean_len: float) -> int:
+        """Concurrent sequences a ``num_blocks`` pool sustains at the
+        observed ``mean_len`` (the second half of the capacity math)."""
+        per_seq = max(1, -(-int(mean_len) // self.block_size))
+        return max(0, (num_blocks - 1) // per_seq)

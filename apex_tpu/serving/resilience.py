@@ -47,10 +47,12 @@ __all__ = ["Rejection", "REJECTION_REASONS", "BrownoutPolicy",
 
 # the closed vocabulary of submit()-time rejections: queue_full (the
 # max_queue bound), shed (BrownoutPolicy), draining (a drain() in
-# progress). Bad INPUT (empty/oversized prompt, non-positive deadline,
-# duplicate in-flight id) still raises ValueError at the caller — a
-# malformed request is a caller bug, not a load condition.
-REJECTION_REASONS = ("queue_full", "shed", "draining")
+# progress), pool_exhausted (a paged engine whose KV block pool could
+# never hold the prompt — transient pressure queues instead). Bad INPUT
+# (empty/oversized prompt, non-positive deadline, duplicate in-flight
+# id) still raises ValueError at the caller — a malformed request is a
+# caller bug, not a load condition.
+REJECTION_REASONS = ("queue_full", "shed", "draining", "pool_exhausted")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -58,6 +58,7 @@ import numpy as np
 from apex_tpu.observability import get_registry
 from apex_tpu.observability.reqtrace import (LATENCY_BUCKETS_MS,
                                              RequestRecord)
+from apex_tpu.serving.cache import PoolExhausted
 from apex_tpu.serving.resilience import Rejection
 
 __all__ = ["Request", "Completion", "SlotScheduler"]
@@ -175,6 +176,9 @@ class SlotScheduler:
         self._any_deadlines = default_deadline_ms is not None
         self._tok_count = 0
         self._tok_t0: Optional[float] = None
+        # paged engines only: the allocator's monotonic COW counter at
+        # the last step, so serve/blocks_cow_copied emits deltas
+        self._cow_seen = 0
 
     # -- submission ---------------------------------------------------------
 
@@ -220,6 +224,20 @@ class SlotScheduler:
             self._reg.counter("serve/rejected").inc()
             return Rejection("queue_full", request.request_id,
                              f"queue at max_queue={self.max_queue}")
+        alloc = getattr(self.engine, "allocator", None)
+        if alloc is not None:
+            # paged admission control: a prompt that could never fit
+            # the WHOLE pool is refused up front (queueing it would
+            # deadlock the queue head forever); transient pressure —
+            # blocks held by in-flight sequences — queues instead and
+            # _admit waits for retirements to free blocks
+            need = alloc.blocks_for(len(request.prompt))
+            if need > alloc.num_blocks - 1:
+                self._reg.counter("serve/rejected").inc()
+                return Rejection(
+                    "pool_exhausted", request.request_id,
+                    f"prompt needs {need} blocks but the pool only has "
+                    f"{alloc.num_blocks - 1} allocatable")
         if self.brownout is not None:
             engaged = self.brownout.engaged()
             self._reg.gauge("serve/brownout").set(1.0 if engaged else 0.0)
@@ -417,12 +435,28 @@ class SlotScheduler:
                 # expired while waiting: never spend a prefill on it
                 self._retire_queued(req, rec, "expired", now)
                 continue
+            if (hasattr(self.engine, "can_admit")
+                    and not self.engine.can_admit(req.prompt)):
+                # paged block-pool pressure: the blocks exist (submit
+                # bounds the prompt to the pool) but in-flight
+                # sequences hold them — requeue at the head and wait
+                # for retirements to free blocks
+                self.queue.appendleft((req, rec))
+                break
             slot = self.free.pop()
             rec.admit_t = now
             rec.slot = slot
             try:
                 first = self.engine.prefill(req.prompt, slot,
                                             req.temperature)
+            except PoolExhausted:
+                # can_admit is conservative but the shared-path COW
+                # headroom can still miss by a block under extreme
+                # pressure: requeue, never error-retire (host rolled
+                # the partial allocation back)
+                self.free.append(slot)
+                self.queue.appendleft((req, rec))
+                break
             except Exception:
                 # the popped request must not vanish: retire it as an
                 # error (host bookkeeping only — the slot never held a
@@ -440,6 +474,18 @@ class SlotScheduler:
             self._temps[slot] = req.temperature
             self._reg.counter("serve/admitted").inc()
             self._reg.counter("serve/prefill_tokens").inc(len(req.prompt))
+            plan = getattr(self.engine, "last_admit", None)
+            if plan is not None and not plan.prefill:
+                # a prefix-shared admission: the shared span skipped
+                # prefill entirely — serve/ttft_prefix_ms is the TTFT
+                # histogram the acceptance bar compares against the
+                # cold serve/ttft_ms population
+                self._reg.counter("serve/prefix_hits").inc()
+                self._reg.counter("serve/prefix_hit_tokens").inc(
+                    plan.shared_tokens)
+                self._reg.histogram("serve/ttft_prefix_ms",
+                                    LATENCY_BUCKETS_MS).observe(
+                    (rec.first_token_t - rec.admit_t) * 1e3)
             admitted += 1
             # the prefill already sampled this request's first token —
             # it may even complete here (max_new_tokens == 1)
@@ -464,6 +510,16 @@ class SlotScheduler:
         try:
             if not self._draining:
                 self._admit()
+            if self.active:
+                # satellite of the paged PR: a slot AT capacity must
+                # retire loudly BEFORE the decode dispatch — its append
+                # would be dropped (KVCache.append writes nothing at
+                # max_len; the paged pool has no block to give), so one
+                # more step would sample a token whose KV never landed
+                now = time.perf_counter()
+                for slot in list(self.active):
+                    if self.active[slot].position >= self.engine.max_len:
+                        self._retire(slot, "capacity", now)
             if self.active:
                 step_idx = self.steps + 1  # this decode step, 1-based
                 poison = None
@@ -496,6 +552,14 @@ class SlotScheduler:
                         continue
                     self._record(int(nxt[slot]), self.active[slot], slot,
                                  now, is_tick=True)
+                # paged engines: slots the exhausted pool could not
+                # give a write block retire loudly as "capacity" — this
+                # step's sampled token is valid (the kernel merges the
+                # current token in-flight) but its KV was dropped, so
+                # one more step would decode against a hole
+                for slot in getattr(self.engine, "last_failed", ()):
+                    if slot in self.active:
+                        self._retire(slot, "capacity", now)
                 # mid-flight deadline enforcement: overdue survivors of
                 # the harvest retire now, slot released for the next
                 # admission
@@ -510,6 +574,14 @@ class SlotScheduler:
         self._reg.counter("serve/generated_tokens").inc(generated)
         self._reg.gauge("serve/queue_depth").set(len(self.queue))
         self._reg.gauge("serve/active_slots").set(len(self.active))
+        alloc = getattr(self.engine, "allocator", None)
+        if alloc is not None:
+            self._reg.gauge("serve/pool_blocks_free").set(
+                alloc.free_blocks)
+            if alloc.cow_copies > self._cow_seen:
+                self._reg.counter("serve/blocks_cow_copied").inc(
+                    alloc.cow_copies - self._cow_seen)
+                self._cow_seen = alloc.cow_copies
         elapsed = time.perf_counter() - self._tok_t0
         if elapsed > 0:
             self._reg.gauge("serve/tokens_per_sec").set(

@@ -66,6 +66,14 @@ Other configs:
              HBM accounting and a prefill-vs-decode pyprof split;
              ``vs_baseline`` is measured over the HBM roofline
              (docs/SERVING.md "Reading bench_gpt_decode");
+  paged    — the paged twin: ``gpt_decode_tok_per_sec_paged`` (the
+             saturating grid through ``PagedServingEngine`` — block-pool
+             cache, bounded-grid kernel; carries ``modeled_hbm_ratio``,
+             the pyprof-modeled paged/dense attention-HBM gap) and
+             ``gpt_decode_ttft_prefix_ms`` (shared-prefix admission vs
+             the cold prefill it skips); engine config is the
+             declarative ``BENCH_DECODE_CONFIGS`` table
+             (docs/SERVING.md "Paged serving");
   fast     — the compound ``fastpath`` preset (tp_comm_overlap +
              bucketed DP + ZeRO-1 backward-interleaved apply +
              selective remat + donation) through the hybrid trainer vs
@@ -860,6 +868,23 @@ def bench_dp_accumulate_overlap(iters=10, warmup=2, K=4, layers=8,
 # off a TPU round (BASELINE.md round 13).
 DECODE_SLO = (("ttft_ms", 95.0, 2000.0), ("tpot_ms", 99.0, 500.0))
 
+# Declarative paged-decode leg config: keys are REAL
+# ``PagedServingEngine.__init__`` keyword parameters — statically
+# validated by scripts/check_bench_configs.py (rule ast-bench-configs),
+# so a renamed engine knob breaks the check instead of TypeError-ing
+# only at bench runtime. num_blocks = max_seqs * (max_len/block_size)
+# + 1 (the reserved null block): full dense-equivalent worst-case
+# capacity, so the throughput delta isolates the bounded-grid kernel,
+# not admission pressure. mean_context prices the kernel's CostEstimate
+# at the fleet's expected live context (docs/SERVING.md "Paged
+# serving").
+BENCH_DECODE_CONFIGS = {
+    "gpt_decode_paged": {
+        "max_seqs": 8, "max_len": 1024, "prefill_len": 128,
+        "block_size": 128, "num_blocks": 65, "mean_context": 160.0,
+    },
+}
+
 
 def bench_gpt_decode(iters=40, warmup=5, prefill_iters=5, max_len=1024,
                      prefill_len=128, sat_slots=8, hidden=768, layers=12,
@@ -1044,6 +1069,148 @@ def bench_gpt_decode(iters=40, warmup=5, prefill_iters=5, max_len=1024,
           slots=sat_slots, max_len=max_len, prefill_len=prefill_len)
 
 
+def bench_gpt_decode_paged(iters=20, warmup=3, prefix_reps=5, hidden=768,
+                           layers=12, heads=12, vocab=32768):
+    """Paged serving legs (docs/SERVING.md "Paged serving"): the same
+    GPT-small shape through the AOT ``PagedServingEngine`` — block-pool
+    KV cache, bounded-grid decode kernel, copy-on-write prefix sharing.
+    The engine config is the declarative
+    ``BENCH_DECODE_CONFIGS["gpt_decode_paged"]`` entry, statically
+    validated by scripts/check_bench_configs.py.
+
+    - ``gpt_decode_tok_per_sec_paged``: every slot of the paged grid
+      active — the throughput twin of ``gpt_decode_tok_per_sec_sat``,
+      same slots/max_len/prefill_len so the delta isolates the paged
+      machinery. ``vs_baseline`` is measured/roofline over the same
+      live-stripe HBM bound as the dense legs; ``modeled_hbm_ratio``
+      carries the pyprof-modeled ``decode_attention`` HBM of this
+      program over the dense engine's — the O(actual_context) vs
+      O(max_len) gap the bounded grid closes (expect it to track
+      ``mean_context / max_len``).
+    - ``gpt_decode_ttft_prefix_ms``: prefill latency for a prompt whose
+      prefix is already registered in the pool (maps the shared blocks,
+      decodes only the un-shared tail) vs the same-length cold path.
+      ``vs_baseline`` is cold/warm (> 1 means prefix sharing pays);
+      ``ttft_cold_ms`` rides the line.
+
+    CPU numbers are structural (interpret-mode kernels); read real
+    latencies off a TPU run."""
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.observability.costs import device_spec
+    from apex_tpu.pyprof import model_program
+    from apex_tpu.serving import (BlockAllocator, PagedKVCache,
+                                  PagedServingEngine, ServingEngine)
+
+    spec = dict(BENCH_DECODE_CONFIGS["gpt_decode_paged"])
+    slots, max_len = spec["max_seqs"], spec["max_len"]
+    prefill_len, block_size = spec["prefill_len"], spec["block_size"]
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                    num_layers=layers, num_attention_heads=heads,
+                    max_position_embeddings=max_len,
+                    compute_dtype=jnp.bfloat16)
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    param_bytes = sum(l.size * l.dtype.itemsize
+                      for l in jax.tree_util.tree_leaves(params))
+    rs = np.random.RandomState(0)
+    eng = PagedServingEngine(model, params, **spec)
+
+    # --- TTFT leg first (the throughput _timeit consumes the donated
+    # cache outside the engine's bookkeeping) ---
+    shared = rs.randint(1, vocab, size=prefill_len).tolist()
+    cold_ms = []
+    for _ in range(prefix_reps):
+        # distinct prompts so every rep takes the cold path
+        t0 = time.perf_counter()
+        eng.prefill(rs.randint(1, vocab, size=prefill_len).tolist(),
+                    slot=0)
+        cold_ms.append((time.perf_counter() - t0) * 1e3)
+        eng.release_slot(0)
+    eng.prefill(shared, slot=0)  # registers the shared prefix
+    warm_ms = []
+    for _ in range(prefix_reps):
+        t0 = time.perf_counter()
+        eng.prefill(shared, slot=1)
+        warm_ms.append((time.perf_counter() - t0) * 1e3)
+        assert not eng.last_admit.prefill, "prefix hit expected"
+        eng.release_slot(1)
+    eng.release_slot(0)
+    cold = float(np.median(cold_ms))
+    warm = float(np.median(warm_ms))
+
+    # --- throughput leg: fresh pool, distinct prompts (no sharing —
+    # the COW/refcount cost is the allocator tests' job), one host-path
+    # step so every slot owns a live decode block, then the frozen
+    # compiled step threaded the _timeit way ---
+    eng.cache = PagedKVCache.create(layers, spec["num_blocks"], heads,
+                                    block_size, cfg.head_dim,
+                                    dtype=jnp.bfloat16)
+    eng.allocator = BlockAllocator(spec["num_blocks"], block_size,
+                                   eng.allocator.blocks_per_slot, slots)
+    for s in range(slots):
+        eng.prefill(rs.randint(1, vocab, size=prefill_len).tolist(),
+                    slot=s)
+    eng.decode(np.zeros(slots, np.int32), np.zeros(slots, np.float32))
+    alloc = eng.allocator
+    bids, offs = alloc.append_targets(np.ones(slots, bool))
+    tables = jnp.asarray(alloc.tables)
+    lengths = jnp.asarray(alloc.lengths)
+    temps = jnp.zeros((slots,), jnp.float32)
+    zs = jnp.zeros((slots,), jnp.int32)
+    bids, offs = jnp.asarray(bids), jnp.asarray(offs)
+    key = eng._next_key()
+
+    def dwrap(cache, toks):
+        cache, toks = eng.decode_compiled(params, cache, tables, lengths,
+                                          toks, temps, bids, offs, zs,
+                                          zs, key)
+        return cache, toks
+
+    times = _timeit(dwrap, (eng.cache, zs), iters, warmup)
+    step_ms = float(np.mean(times) * 1e3)
+    tok_per_sec = slots / float(np.mean(times))
+
+    # the same live-stripe roofline as the dense legs, at the ACTUAL
+    # mean context — the paged step's HBM target, not max_len's
+    mean_len = float(np.mean(np.asarray(alloc.lengths)))
+    stripe = (2 * layers * heads * mean_len * cfg.head_dim
+              * jnp.dtype(jnp.bfloat16).itemsize)
+    dspec = device_spec()
+    roofline = slots / ((param_bytes + slots * stripe)
+                        / (dspec.hbm_gbps * 1e9))
+
+    extras = dict(_mem_extra(eng.decode_compiled))
+    extras.update(_attrib_extra(eng.decode_traced, step_ms))
+    # the modeled attention-HBM gap: this program's decode_attention
+    # bytes over the dense engine's at identical shapes — the number
+    # the bounded grid exists to shrink (CostEstimate-priced, so it
+    # reflects the clamped grid, not the dense worst case)
+    try:
+        dense = ServingEngine(model, params, max_seqs=slots,
+                              max_len=max_len, prefill_len=prefill_len)
+        paged_hbm = model_program(
+            eng.decode_traced).regions["decode_attention"].hbm_bytes
+        dense_hbm = model_program(
+            dense.decode_traced).regions["decode_attention"].hbm_bytes
+        if dense_hbm > 0:
+            extras["modeled_hbm_ratio"] = round(paged_hbm / dense_hbm, 4)
+    except Exception:
+        pass
+
+    _emit("gpt_decode_tok_per_sec_paged", tok_per_sec, "tokens/sec",
+          tok_per_sec / roofline, anchor="hbm_roofline_this_chip",
+          roofline_tok_per_sec=round(roofline, 2),
+          step_ms=round(step_ms, 3),
+          std_ms=round(float(np.std(times) * 1e3), 3),
+          slots=slots, max_len=max_len, prefill_len=prefill_len,
+          block_size=block_size, num_blocks=spec["num_blocks"],
+          mean_context=spec["mean_context"], iters=iters, **extras)
+    _emit("gpt_decode_ttft_prefix_ms", warm,
+          "ms", None if warm <= 0 else cold / warm,
+          ttft_cold_ms=round(cold, 3), prefill_len=prefill_len,
+          shared_tokens=prefill_len - 1, reps=prefix_reps)
+
+
 def bench_flash_long(seq=4096, b=8, h=12, d=64):
     """Long-context evidence: flash (auto 512-blocks) vs XLA attention
     fwd+bwd at seq 4096 — the regime the reference cannot reach at all
@@ -1098,13 +1265,16 @@ def main():
         # sp_ovl (two GPT TP=2 compiles) after the longer-tracked configs
         # above it, remat (FOUR GPT-small train-step compiles) next,
         # gpt_fast (two full hybrid-trainer compiles) after that, and
-        # gpt_decode (two serving engines = four AOT compiles, the
-        # newest leg) dead last so a tight budget drops the newest
-        # metrics, never the established baseline rows
+        # gpt_decode (two serving engines = four AOT compiles) next,
+        # and gpt_decode_paged (one paged engine = three AOT compiles
+        # plus a dense twin for the modeled-HBM ratio, the newest leg)
+        # dead last so a tight budget drops the newest metrics, never
+        # the established baseline rows
         for fn in (bench_layernorm, bench_optimizer, bench_gpt,
                    bench_flash_long, bench_dp_accumulate_overlap,
                    bench_gpt_sp_overlap, bench_gpt_remat,
-                   bench_gpt_fast, bench_gpt_decode):
+                   bench_gpt_fast, bench_gpt_decode,
+                   bench_gpt_decode_paged):
             if time.perf_counter() - t0 > budget_s:
                 _emit(fn.__name__, -1.0, "skipped", None,
                       error="config budget exhausted; headline protected")

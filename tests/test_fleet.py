@@ -216,11 +216,15 @@ class TestFleetPublisher:
 # ---------------------------------------------------------------------------
 
 def _rank_snapshot(run_dir, rank, step, steps_counter, wall_ms=None,
-                   health=None):
+                   health=None, pool_free=None, cow_copies=None):
     reg = MetricsRegistry()
     reg.counter("train/steps").inc(steps_counter)
     if wall_ms is not None:
         reg.gauge("perf/step_wall_ms").set(wall_ms)
+    if pool_free is not None:
+        reg.gauge("serve/pool_blocks_free").set(pool_free)
+    if cow_copies is not None:
+        reg.counter("serve/blocks_cow_copied").inc(cow_copies)
     pub = FleetPublisher(run_dir, rank=rank, registry=reg)
     if health:
         pub(step, health)
@@ -257,14 +261,20 @@ class TestFleetAggregator:
     def test_merged_registry_includes_supervisor_and_sums_ranks(
             self, tmp_path):
         run = str(tmp_path)
-        _rank_snapshot(run, 0, step=2, steps_counter=2)
-        _rank_snapshot(run, 1, step=2, steps_counter=2)
+        _rank_snapshot(run, 0, step=2, steps_counter=2,
+                       pool_free=40.0, cow_copies=1)
+        _rank_snapshot(run, 1, step=2, steps_counter=2,
+                       pool_free=20.0, cow_copies=2)
         sup = MetricsRegistry()
         sup.gauge("elastic/world_size").set(2)
         sup.counter("elastic/restarts").inc()
         merged = FleetAggregator(run, registry=sup).merged_registry()
         snap = merged.snapshot()
         assert snap["train/steps"] == 4.0
+        # paged-serving pool surface rides the same merge: the free-block
+        # gauge lands as the cross-rank mean, the COW counter sums.
+        assert snap["serve/pool_blocks_free"] == 30.0
+        assert snap["serve/blocks_cow_copied"] == 3.0
         assert snap["elastic/world_size"] == 2.0
         assert snap["elastic/restarts"] == 1.0
         text = merged.render_prometheus()

@@ -175,9 +175,18 @@ class TestKVCache:
     def test_append_saturates_at_max_len(self):
         cache = KVCache.create(1, 1, 1, 2, 4, dtype=jnp.float32)
         u = jnp.ones((1, 1, 1, 4))
-        for _ in range(4):
-            cache = cache.append(u, u)
+        cache = cache.append(u, u)
+        cache = cache.append(2 * u, 2 * u)      # fills max_len
+        for _ in range(2):
+            cache = cache.append(9 * u, 9 * u)  # saturated appends
         assert int(cache.lengths[0]) == 2  # clamped, no OOB write
+        # a saturated slot writes NOTHING: the last position keeps its
+        # value (the old semantics silently overwrote position
+        # max_len-1 with each newest token's KV — the scheduler now
+        # retires at capacity BEFORE the dispatch, and the cache write
+        # is a no-op even if one slips through)
+        assert float(cache.k[0, 0, 0, 1, 0]) == 2.0
+        assert float(cache.v[0, 0, 0, 1, 0]) == 2.0
 
     def test_int8_roundtrip(self):
         cache = KVCache.create(1, 1, 2, 4, 8, dtype=jnp.int8)
