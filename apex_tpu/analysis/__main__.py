@@ -1,10 +1,10 @@
 """CLI: ``python -m apex_tpu.analysis [--all|--rule NAME] [--json]``.
 
 Family-B (ast) rules run over this repository tree; Family-A (jaxpr)
-rules run their built-in selfchecks — each rule's tiny clean program must
-stay silent AND its planted violation must fire, so a green ``--all``
-proves every rule in both directions (a rule that stopped firing is as
-rotten as a tree that stopped passing). Exit status: 0 clean, 1 findings
+and Family-C (perf) rules run their built-in selfchecks — each rule's
+tiny clean program/history must stay silent AND its planted violation
+must fire, so a green ``--all`` proves every rule in both directions (a
+rule that stopped firing is as rotten as a tree that stopped passing). Exit status: 0 clean, 1 findings
 (or a broken selfcheck), 2 usage error.
 """
 
@@ -31,7 +31,7 @@ def _run_jaxpr(rule, out):
     clean, planted = rule.selfcheck()
     ok = not clean and bool(planted)
     out["rules"].append({
-        "rule": rule.name, "family": "jaxpr", "ok": ok,
+        "rule": rule.name, "family": rule.family, "ok": ok,
         "findings": [f.to_dict() for f in clean],
         "planted_fired": len(planted)})
     findings = list(clean)

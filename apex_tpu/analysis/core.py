@@ -12,6 +12,11 @@ One vocabulary for both rule families:
   the package (no jax import). These are the six historical
   ``scripts/check_*.py`` contracts plus the metric-family meta-lint,
   consolidated onto one walker core (:mod:`apex_tpu.analysis.astlint`).
+- **Family C (perf)** — selfcheck-only dynamic detectors (the perfwatch
+  regression detector): like the jaxpr family they expose
+  ``selfcheck() -> (clean, planted)`` and ride the same CLI leg — a
+  detector that stops firing on its planted regression fails ``--all``
+  like a finding (the PR 11 dead-rule convention).
 
 ``python -m apex_tpu.analysis --all`` runs every registered rule; each
 ``scripts/check_*.py`` shim runs exactly its ported rule with the
@@ -69,7 +74,7 @@ class Rule:
     fires, so ``--all`` proves every rule in both directions.
     """
     name: str
-    family: str  # 'ast' | 'jaxpr'
+    family: str  # 'ast' | 'jaxpr' | 'perf'
     doc: str     # one line: the real bug class this rule encodes
     run: Optional[Callable[[str], Tuple[List[Finding], List[str]]]] = None
     selfcheck: Optional[
@@ -82,7 +87,7 @@ RULES: Dict[str, Rule] = {}
 def register(rule: Rule) -> Rule:
     if rule.name in RULES:
         raise ValueError(f"duplicate rule name {rule.name!r}")
-    if rule.family not in ("ast", "jaxpr"):
+    if rule.family not in ("ast", "jaxpr", "perf"):
         raise ValueError(f"unknown rule family {rule.family!r}")
     RULES[rule.name] = rule
     return rule
@@ -108,8 +113,10 @@ def iter_rules(family: Optional[str] = None):
 def _ensure_loaded() -> None:
     """Rule modules register on import; AST rules are import-light
     (stdlib ast only), jaxpr rules import jax lazily inside their
-    bodies."""
-    from apex_tpu.analysis import program, rules_ast  # noqa: F401
+    bodies, the perf family imports only the (jax-free) perfwatch
+    module."""
+    from apex_tpu.analysis import (program, rules_ast,  # noqa: F401
+                                   rules_perf)
 
 
 def format_finding(f: Finding) -> str:

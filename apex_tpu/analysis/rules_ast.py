@@ -26,6 +26,9 @@ Rule -> encoded bug class (details + allowlisting in docs/ANALYSIS.md):
   (the supervisor CLI's exit-code propagation).
 - ``ast-bench-configs`` — a bench-config key that no longer names a real
   config dataclass field (the leg silently falls back to defaults).
+- ``ast-bench-history`` — the perfwatch JSONL schema keys drift from the
+  writer's literal ``HISTORY_FIELDS`` table (a renamed key silently
+  forks every future history file from every past one).
 """
 
 from __future__ import annotations
@@ -47,10 +50,12 @@ __all__ = ["ANNOTATIONS", "ALLOWED_GATHER", "ALLOWED_SCATTER",
            "METRIC_CALLEES", "TAG_CALLEES", "REGISTRY_FILE", "ELASTIC_DIR",
            "CHOKEPOINT_FILE", "CHOKEPOINT_FUNC", "LAUNCH_FILE",
            "LAUNCH_CHOKEPOINT_FUNC", "CONFIG_CLASSES",
-           "SECTIONS", "SLO_METRICS", "DOC", "rule_annotations",
-           "rule_collectives",
+           "SECTIONS", "SLO_METRICS", "DOC", "PERFWATCH_FILE",
+           "HISTORY_TABLE", "HISTORY_WRITER", "HISTORY_JSONL",
+           "rule_annotations", "rule_collectives",
            "rule_metrics_doc", "rule_metric_families", "rule_remat_names",
-           "rule_elastic_exits", "rule_bench_configs"]
+           "rule_elastic_exits", "rule_bench_configs",
+           "rule_bench_history"]
 
 Findings = Tuple[List[Finding], List[str]]
 
@@ -806,6 +811,144 @@ def rule_bench_configs(repo: str) -> Findings:
 
 
 # ---------------------------------------------------------------------------
+# ast-bench-history: the perfwatch JSONL schema stays pinned to its writer
+# ---------------------------------------------------------------------------
+
+PERFWATCH_FILE = _p(PACKAGE, "observability", "perfwatch.py")
+HISTORY_TABLE = "HISTORY_FIELDS"
+HISTORY_WRITER = "make_record"
+HISTORY_JSONL = "BENCH_HISTORY.jsonl"
+
+
+def _history_writer_keys(path: str):
+    """``(base_keys, promoted_keys)`` of the history writer: the literal
+    keys of ``make_record``'s record dict (the always-present set) and
+    every literal ``rec["..."] = ...`` subscript it assigns (the
+    conditionally-promoted set). None when the function is absent."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.FunctionDef)
+                and node.name == HISTORY_WRITER):
+            continue
+        base, promoted = [], []
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Dict):
+                keys = [k.value for k in sub.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)]
+                if "metric" in keys:
+                    base = keys
+            if isinstance(sub, ast.Assign):
+                for t in sub.targets:
+                    if isinstance(t, ast.Subscript) \
+                            and isinstance(t.slice, ast.Constant) \
+                            and isinstance(t.slice.value, str):
+                        promoted.append(t.slice.value)
+        return base, promoted
+    return None
+
+
+def rule_bench_history(repo: str) -> Findings:
+    """The longitudinal twin of ``ast-bench-configs``: perfwatch's
+    ``HISTORY_FIELDS`` literal is the one schema every
+    ``BENCH_HISTORY.jsonl`` record obeys — the writer's always-present
+    dict keys must equal the table's ``required`` set, its promoted
+    keys must come from the table, and any on-disk history at the repo
+    root must match both (a key outside the table means a reader and a
+    writer already disagree)."""
+    findings, notes = [], []
+    path = os.path.join(repo, PERFWATCH_FILE)
+    try:
+        table = _literal_assign(path, HISTORY_TABLE)
+        writer = _history_writer_keys(path)
+    except (OSError, SyntaxError, ValueError) as e:
+        return [Finding("ast-bench-history", "MISSING", PERFWATCH_FILE,
+                        str(e))], []
+    if table is None:
+        return [Finding(
+            "ast-bench-history", "MISSING", PERFWATCH_FILE,
+            f"no literal {HISTORY_TABLE} table (the JSONL schema must "
+            f"be stated declaratively)")], []
+
+    fields, required, ok_shape = {}, set(), True
+    for entry in table:
+        if not (isinstance(entry, tuple) and len(entry) == 2
+                and isinstance(entry[0], str)
+                and entry[1] in ("required", "optional")):
+            ok_shape = False
+            findings.append(Finding(
+                "ast-bench-history", "UNKNOWN",
+                f"{HISTORY_TABLE}[{entry!r}]",
+                "expected a (field, 'required'|'optional') pair"))
+            continue
+        fields[entry[0]] = entry[1]
+        if entry[1] == "required":
+            required.add(entry[0])
+    if ok_shape:
+        notes.append(f"ok       {HISTORY_TABLE}: {len(fields)} field(s), "
+                     f"{len(required)} required")
+
+    if writer is None:
+        findings.append(Finding(
+            "ast-bench-history", "MISSING", PERFWATCH_FILE,
+            f"no {HISTORY_WRITER}() writer to validate "
+            f"{HISTORY_TABLE} against"))
+    else:
+        base, promoted = writer
+        for key in sorted(required - set(base)):
+            findings.append(Finding(
+                "ast-bench-history", "MISSING",
+                f"{PERFWATCH_FILE}::{HISTORY_WRITER}",
+                f"required field {key!r} absent from the writer's "
+                f"record literal"))
+        for key in sorted(set(base) - required):
+            findings.append(Finding(
+                "ast-bench-history", "ROGUE",
+                f"{PERFWATCH_FILE}::{HISTORY_WRITER}",
+                f"writer always emits {key!r}, which {HISTORY_TABLE} "
+                f"does not list as required"))
+        for key in sorted(set(promoted) - set(fields)):
+            findings.append(Finding(
+                "ast-bench-history", "ROGUE",
+                f"{PERFWATCH_FILE}::{HISTORY_WRITER}",
+                f"writer promotes {key!r}, which is not in "
+                f"{HISTORY_TABLE} at all"))
+        if set(base) == required and set(promoted) <= set(fields):
+            notes.append(f"ok       {HISTORY_WRITER}: {len(base)} base + "
+                         f"{len(set(promoted))} promoted key(s) match")
+
+    jsonl = os.path.join(repo, HISTORY_JSONL)
+    if os.path.exists(jsonl):
+        checked = 0
+        with open(jsonl) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                where = f"{HISTORY_JSONL}:{lineno}"
+                try:
+                    rec = json.loads(line)
+                except ValueError as e:
+                    findings.append(Finding(
+                        "ast-bench-history", "UNKNOWN", where, str(e)))
+                    continue
+                keys = set(rec) if isinstance(rec, dict) else set()
+                for key in sorted(required - keys):
+                    findings.append(Finding(
+                        "ast-bench-history", "MISSING", where,
+                        f"record lacks required field {key!r}"))
+                for key in sorted(keys - set(fields)):
+                    findings.append(Finding(
+                        "ast-bench-history", "UNKNOWN", where,
+                        f"record key {key!r} is not in {HISTORY_TABLE}"))
+                checked += 1
+        notes.append(f"ok       {HISTORY_JSONL}: {checked} record(s) "
+                     f"checked")
+    return findings, notes
+
+
+# ---------------------------------------------------------------------------
 # registration
 # ---------------------------------------------------------------------------
 
@@ -833,3 +976,7 @@ register(Rule("ast-elastic-exits", "ast",
 register(Rule("ast-bench-configs", "ast",
               "bench-config keys name real config dataclass fields",
               run=rule_bench_configs))
+register(Rule("ast-bench-history", "ast",
+              "the perfwatch JSONL schema (writer keys + on-disk "
+              "records) matches the literal HISTORY_FIELDS table",
+              run=rule_bench_history))

@@ -1,0 +1,46 @@
+"""Family-C rule: the perfwatch regression detector's selfcheck.
+
+A regression detector that silently stops firing is worse than no
+detector — every later bench round reads as "no regressions" while the
+trajectory rots. So the detector registers here as a selfcheck-only
+rule, per the PR 11 convention: ``python -m apex_tpu.analysis --all``
+runs it alongside the jaxpr selfchecks, a clean synthetic history must
+stay silent, and a planted 20% throughput drop must fire — *with the
+suspect region attributed* (a firing without a region means the
+AttributionDiff wiring rotted, and is reported dead all the same).
+
+The perfwatch module is jax-free, so this family keeps the CLI's
+no-accelerator path fast. Details: docs/ANALYSIS.md, and
+docs/OBSERVABILITY.md "Performance observatory".
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from apex_tpu.analysis.core import Finding, Rule, register
+
+__all__ = ["perfwatch_selfcheck"]
+
+
+def perfwatch_selfcheck() -> Tuple[List[Finding], List[Finding]]:
+    """``(clean_findings, planted_findings)`` over the built-in
+    synthetic histories (see
+    :func:`apex_tpu.observability.perfwatch.selfcheck`)."""
+    from apex_tpu.observability.perfwatch import selfcheck
+    clean, planted = selfcheck()
+
+    def _wrap(finding) -> Finding:
+        kind = "DRIFT" if type(finding).__name__ == "DriftShift" \
+            else "REGRESSION"
+        return Finding("perf-regression", kind, finding.metric,
+                       finding.message())
+
+    return [_wrap(f) for f in clean], [_wrap(f) for f in planted]
+
+
+register(Rule(
+    "perf-regression", "perf",
+    "the perfwatch detector still fires: clean synthetic history "
+    "silent, planted 20% drop flagged with its suspect region",
+    selfcheck=perfwatch_selfcheck))

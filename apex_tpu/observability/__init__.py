@@ -36,6 +36,14 @@ Composable modules, each zero-cost when unused:
   Chrome-trace export, and :class:`SLOTracker` — declarative latency
   targets, rolling goodput/burn-rate gauges (``slo/*``), and a
   flight-recorder :class:`CrashDump` on violation;
+- :mod:`~apex_tpu.observability.perfwatch` — the performance
+  observatory: the append-only ``BENCH_HISTORY.jsonl`` bench history
+  (:class:`BenchHistory`, full-precision ``raw_value`` + git/host
+  provenance, ``BENCH_r*.json`` importer), the rolling-median+MAD
+  :class:`RegressionDetector` with unit-inferred direction,
+  :class:`AttributionDiff` region diffs naming the suspect region, and
+  measured/modeled cost-model drift (``perf/model_drift`` gauges +
+  shift alerts); CLI: ``python -m apex_tpu.perfwatch``;
 - :mod:`~apex_tpu.observability.fleet` — the cross-rank merge layer:
   rank-side registry snapshots (:class:`FleetPublisher`, atomic JSON),
   the supervisor-side :class:`FleetAggregator` (counters sum, gauges
@@ -77,3 +85,7 @@ from apex_tpu.observability.slo import (  # noqa: F401
 from apex_tpu.observability.fleet import (  # noqa: F401
     FleetAggregator, FleetPublisher, MetricsServer, PostmortemReport,
     merge_registry_dicts)
+from apex_tpu.observability.perfwatch import (  # noqa: F401
+    AttributionDiff, BenchHistory, DriftShift, Regression,
+    RegressionDetector, detect_drift_shifts, drift_series, publish_drift,
+    unit_direction)

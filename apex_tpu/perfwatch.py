@@ -1,0 +1,12 @@
+"""``python -m apex_tpu.perfwatch`` — the performance-observatory CLI.
+
+Thin executable shim over :mod:`apex_tpu.observability.perfwatch` (the
+library lives with the other observability layers; the CLI rides at
+package level like ``python -m apex_tpu.analysis``). Exit status: 0
+clean, 1 regressions / drift shifts / dead selfcheck, 2 usage error.
+"""
+
+from apex_tpu.observability.perfwatch import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -578,6 +578,14 @@ class SlotScheduler:
         if alloc is not None:
             self._reg.gauge("serve/pool_blocks_free").set(
                 alloc.free_blocks)
+            # used + utilization next to free: free blocks alone cannot
+            # separate fragmentation from load (block 0 is the reserved
+            # null block, so allocatable capacity is num_blocks - 1)
+            capacity = alloc.num_blocks - 1
+            used = capacity - alloc.free_blocks
+            self._reg.gauge("serve/pool_blocks_used").set(used)
+            self._reg.gauge("serve/pool_utilization").set(
+                used / capacity if capacity else 0.0)
             if alloc.cow_copies > self._cow_seen:
                 self._reg.counter("serve/blocks_cow_copied").inc(
                     alloc.cow_copies - self._cow_seen)

@@ -140,11 +140,22 @@ def _attrib_extra(traced, step_ms) -> dict:
     try:
         from apex_tpu.pyprof import attribute
         rep = attribute(traced, step_ms / 1e3)
-        out = {"modeled_step_ms": round(rep.modeled_step_ms, 3)}
+        out = {"modeled_step_ms": round(rep.modeled_step_ms, 3),
+               "step_time_ms": round(float(step_ms), 3)}
         if rep.comm_exposed_ms is not None:
             out["comm_exposed_ms"] = round(rep.comm_exposed_ms, 3)
         if rep.overlap_efficiency is not None:
             out["overlap_efficiency"] = round(rep.overlap_efficiency, 4)
+        # the per-region breakdown rides ONLY into BENCH_HISTORY.jsonl
+        # (popped from the printed line by _emit): perfwatch's
+        # AttributionDiff names the region whose ms moved when a later
+        # round regresses (docs/OBSERVABILITY.md "Performance
+        # observatory")
+        out["attribution"] = [
+            {"region": r.name, "modeled_ms": round(r.modeled_ms, 4),
+             **({} if r.measured_ms is None
+                else {"measured_ms": round(r.measured_ms, 4)})}
+            for r in rep.regions]
         return out
     except Exception:
         return {}
@@ -196,15 +207,60 @@ def _timed(f) -> float:
 
 
 _RESULTS = []
+_HISTORY = None
+
+
+def _history():
+    """The append target for the performance observatory
+    (``BENCH_HISTORY.jsonl`` next to this script; ``APEX_BENCH_HISTORY``
+    overrides the path, ``=off`` disables). Lazy and failure-proof —
+    longitudinal bookkeeping must never break a bench run."""
+    global _HISTORY
+    if _HISTORY is None:
+        try:
+            from apex_tpu.observability.perfwatch import BenchHistory
+            dest = os.environ.get(
+                "APEX_BENCH_HISTORY",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_HISTORY.jsonl"))
+            _HISTORY = False if dest.lower() in ("", "0", "off", "none") \
+                else BenchHistory(dest)
+        except Exception:
+            _HISTORY = False
+    # explicit False check: an EMPTY BenchHistory is len()-falsy
+    return None if _HISTORY is False else _HISTORY
 
 
 def _emit(metric, value, unit, vs_baseline, **extra):
+    # the per-region attribution block and the drift numerator are
+    # history-only: printed lines (and BENCH_CONFIGS.json) keep their
+    # pre-observatory shape, the cross-run differ is the only consumer
+    attribution = extra.pop("attribution", None)
+    step_time_ms = extra.pop("step_time_ms", None)
     line = {"metric": metric, "value": round(float(value), 2), "unit": unit,
             "vs_baseline": (None if vs_baseline is None
                             else round(float(vs_baseline), 4))}
     line.update(extra)
     _RESULTS.append(line)
     print(json.dumps(line), flush=True)
+    hist = _history()
+    if hist is not None:
+        try:
+            extras = dict(extra)
+            if attribution is not None:
+                extras["attribution"] = attribution
+            if step_time_ms is not None:
+                extras["step_time_ms"] = step_time_ms
+            # raw_value carries full precision: the printed 2-decimal
+            # value quantizes away sub-0.5% deltas (the class of bug
+            # that forced gpt_decode_goodput into percent), and the
+            # regression detector needs them
+            hist.record(metric, value, unit, vs_baseline,
+                        raw_value=float(value),
+                        run=os.environ.get("BENCH_RUN"),
+                        source="bench", extras=extras)
+        except Exception:
+            pass
 
 
 def bench_headline(iters=50, warmup=5):
@@ -1036,7 +1092,8 @@ def bench_gpt_decode(iters=40, warmup=5, prefill_iters=5, max_len=1024,
         extras = dict(_mem_extra(eng.decode_compiled))
         extras.update(_attrib_extra(eng.decode_traced, step_ms))
         extras.update({f"prefill_{k}": v for k, v in _attrib_extra(
-            eng.prefill_traced, prefill_ms).items()})
+            eng.prefill_traced, prefill_ms).items()
+            if k not in ("attribution", "step_time_ms")})
         # request-lifecycle percentiles: the timing loop consumed the
         # donated cache again — fresh one, then a real scheduler run on
         # the same compiled programs

@@ -12,7 +12,14 @@ switch platforms via ``jax.config`` — which works any time before the backend
 is first used — rather than via environment variables.
 """
 
-from apex_tpu.utils.hostmesh import force_virtual_cpu_devices
+import os
+
+# Bench smoke tests drive bench.py's real _emit path; their shrunken-shape
+# numbers must never land in the repo's longitudinal BENCH_HISTORY.jsonl.
+# Tests that exercise the history round-trip re-point this at a tmp path.
+os.environ.setdefault("APEX_BENCH_HISTORY", "off")
+
+from apex_tpu.utils.hostmesh import force_virtual_cpu_devices  # noqa: E402
 
 force_virtual_cpu_devices(8)
 

@@ -37,6 +37,7 @@ from apex_tpu.analysis.program import (check_donation,
 from apex_tpu.analysis.rules_ast import (ANNOTATIONS, METRIC_PREFIXES,
                                          rule_annotations,
                                          rule_bench_configs,
+                                         rule_bench_history,
                                          rule_collectives,
                                          rule_elastic_exits,
                                          rule_metric_families,
@@ -186,21 +187,27 @@ def _plant_metrics_doc(tmp_path):
            # rejection/expiry/poison counter must fire like any other
            "    reg.counter('serve/rogue_rejected').inc()\n"
            "    reg.counter('serve/rogue_poisoned').inc()\n"
-           "    reg.gauge('serve/rogue_brownout').set(x)\n")
+           "    reg.gauge('serve/rogue_brownout').set(x)\n"
+           # the PR 18 perfwatch call shapes: a scalar drift gauge and a
+           # per-metric f-string drift family — the observatory's
+           # published names are under the contract like any other perf/
+           "    reg.gauge('perf/rogue_drift').set(x)\n"
+           "    reg.gauge(f'perf/rogue_drift/{name}').set(x)\n")
     _write(tmp_path, "docs/OBSERVABILITY.md", "| nothing documented |\n")
 
 
 def _expect_metrics_doc(findings):
     undoc = [f for f in findings if f.kind == "UNDOC"]
-    # record x2 + gauge x5 + counter x4 + hist x2
-    assert len(undoc) == 13
+    # record x2 + gauge x7 + counter x4 + hist x2
+    assert len(undoc) == 15
     for name in ("health/rogue_metric", "health/<>/rogue_family",
                  "perf/rogue_attribution", "ckpt/rogue_bytes",
                  "serve/rogue_ms", "serve/rogue_wait_ms",
                  "slo/rogue_goodput", "elastic/rogue_world",
                  "fleet/rogue_skew", "train/rogue_steps",
                  "serve/rogue_rejected", "serve/rogue_poisoned",
-                 "serve/rogue_brownout"):
+                 "serve/rogue_brownout", "perf/rogue_drift",
+                 "perf/rogue_drift/<>"):
         assert any(name in f.message for f in undoc), name
 
 
@@ -383,6 +390,56 @@ def _expect_bench(findings):
     assert not any("'zero'" in f.message for f in unknown)
 
 
+def _plant_bench_history(tmp_path):
+    """A perfwatch-era schema fork: the writer renamed ``value`` to
+    ``display_value`` and grew a ``hostname`` promotion the table never
+    learned about, while an on-disk history still carries both old- and
+    new-world records."""
+    _write(tmp_path, "apex_tpu/observability/perfwatch.py",
+           "HISTORY_FIELDS = (\n"
+           "    ('metric', 'required'),\n"
+           "    ('value', 'required'),\n"
+           "    ('raw_value', 'required'),\n"
+           "    ('unit', 'required'),\n"
+           "    ('config', 'optional'),\n"
+           ")\n"
+           "def make_record(metric, value, unit):\n"
+           "    rec = {\n"
+           "        'metric': metric,\n"
+           "        'display_value': round(value, 2),\n"
+           "        'raw_value': value,\n"
+           "        'unit': unit,\n"
+           "    }\n"
+           "    rec['hostname'] = 'n1'\n"
+           "    return rec\n")
+    _write(tmp_path, "BENCH_HISTORY.jsonl",
+           '{"metric": "m", "value": 1.0, "raw_value": 1.0,'
+           ' "unit": "ms", "rogue_key": 1}\n'
+           '{"metric": "m"}\n')
+
+
+def _expect_bench_history(findings):
+    writer = [f for f in findings if "make_record" in f.where]
+    # the renamed required key fires both ways: absent + rogue
+    assert any(f.kind == "MISSING" and "'value'" in f.message
+               for f in writer)
+    assert any(f.kind == "ROGUE" and "'display_value'" in f.message
+               for f in writer)
+    # the un-tabled promotion
+    assert any(f.kind == "ROGUE" and "'hostname'" in f.message
+               for f in writer)
+    disk = [f for f in findings if "BENCH_HISTORY.jsonl" in f.where]
+    assert any(f.kind == "UNKNOWN" and "'rogue_key'" in f.message
+               and ":1" in f.where for f in disk)
+    missing2 = [f for f in disk if f.kind == "MISSING" and ":2" in f.where]
+    assert {m.split("'")[1] for m in (f.message for f in missing2)} == \
+        {"value", "raw_value", "unit"}
+    # keys the table DOES know are not flagged
+    assert not any("'config'" in f.message for f in findings)
+    assert not any("'raw_value'" in f.message and f.kind != "MISSING"
+                   for f in findings)
+
+
 def test_slo_metric_mirror_pinned():
     """rules_ast.SLO_METRICS is a jax-free mirror of the slo module's
     latency vocabulary — they must never drift."""
@@ -415,6 +472,8 @@ PLANTED = [
      _plant_launch_choke_rot, _expect_launch_choke_rot),
     ("ast-bench-configs", rule_bench_configs, _plant_bench,
      _expect_bench),
+    ("ast-bench-history", rule_bench_history, _plant_bench_history,
+     _expect_bench_history),
 ]
 
 
@@ -431,7 +490,8 @@ def test_missing_inputs_fail_loudly(tmp_path):
     """A tree missing the contract anchors is a failure, not a pass."""
     (tmp_path / "apex_tpu").mkdir()
     for rule_fn in (rule_metrics_doc, rule_remat_names,
-                    rule_elastic_exits, rule_bench_configs):
+                    rule_elastic_exits, rule_bench_configs,
+                    rule_bench_history):
         findings, _ = rule_fn(str(tmp_path))
         assert any(f.kind == "MISSING" for f in findings), rule_fn
 
@@ -447,7 +507,8 @@ def test_documenting_fixes_metrics_doc(tmp_path):
            "| `slo/rogue_goodput` | `elastic/rogue_world` |\n"
            "| `fleet/rogue_skew` | `train/rogue_steps` |\n"
            "| `serve/rogue_rejected` | `serve/rogue_poisoned` |\n"
-           "| `serve/rogue_brownout` |\n")
+           "| `serve/rogue_brownout` | `perf/rogue_drift` |\n"
+           "| `perf/rogue_drift/<metric>` |\n")
     findings, _ = rule_metrics_doc(str(tmp_path))
     assert not findings
 

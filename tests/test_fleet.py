@@ -216,7 +216,8 @@ class TestFleetPublisher:
 # ---------------------------------------------------------------------------
 
 def _rank_snapshot(run_dir, rank, step, steps_counter, wall_ms=None,
-                   health=None, pool_free=None, cow_copies=None):
+                   health=None, pool_free=None, cow_copies=None,
+                   pool_used=None, pool_util=None):
     reg = MetricsRegistry()
     reg.counter("train/steps").inc(steps_counter)
     if wall_ms is not None:
@@ -225,6 +226,10 @@ def _rank_snapshot(run_dir, rank, step, steps_counter, wall_ms=None,
         reg.gauge("serve/pool_blocks_free").set(pool_free)
     if cow_copies is not None:
         reg.counter("serve/blocks_cow_copied").inc(cow_copies)
+    if pool_used is not None:
+        reg.gauge("serve/pool_blocks_used").set(pool_used)
+    if pool_util is not None:
+        reg.gauge("serve/pool_utilization").set(pool_util)
     pub = FleetPublisher(run_dir, rank=rank, registry=reg)
     if health:
         pub(step, health)
@@ -262,9 +267,11 @@ class TestFleetAggregator:
             self, tmp_path):
         run = str(tmp_path)
         _rank_snapshot(run, 0, step=2, steps_counter=2,
-                       pool_free=40.0, cow_copies=1)
+                       pool_free=40.0, cow_copies=1,
+                       pool_used=23.0, pool_util=23.0 / 63.0)
         _rank_snapshot(run, 1, step=2, steps_counter=2,
-                       pool_free=20.0, cow_copies=2)
+                       pool_free=20.0, cow_copies=2,
+                       pool_used=43.0, pool_util=43.0 / 63.0)
         sup = MetricsRegistry()
         sup.gauge("elastic/world_size").set(2)
         sup.counter("elastic/restarts").inc()
@@ -275,6 +282,8 @@ class TestFleetAggregator:
         # gauge lands as the cross-rank mean, the COW counter sums.
         assert snap["serve/pool_blocks_free"] == 30.0
         assert snap["serve/blocks_cow_copied"] == 3.0
+        assert snap["serve/pool_blocks_used"] == 33.0
+        assert abs(snap["serve/pool_utilization"] - 33.0 / 63.0) < 1e-9
         assert snap["elastic/world_size"] == 2.0
         assert snap["elastic/restarts"] == 1.0
         text = merged.render_prometheus()
