@@ -472,24 +472,31 @@ def lint_trainer_step(trainer, state, tokens, targets, *,
 
 
 def lint_serving_engine(engine) -> List[Finding]:
-    """Donation safety over the three AOT serving programs (prefill /
-    decode / release, all with the donated cache) plus grad-sync
-    collective placement on the decode program (a serving step has no
-    business reducing gradients at all)."""
+    """Donation safety over the AOT serving programs (prefill / decode /
+    release — plus ``verify`` on a speculative engine — all with the
+    donated cache) plus grad-sync collective placement on the decode
+    program (a serving step has no business reducing gradients at
+    all)."""
     import jax
     cache = engine.cache
     n = len(jax.tree_util.tree_leaves(cache))
     nbytes = cache.nbytes()
     findings = check_donation(donated_args=cache,
                               label="ServingEngine.cache")
-    for name, compiled in (("prefill", engine.prefill_compiled),
-                           ("decode", engine.decode_compiled),
-                           ("release", engine.release_compiled)):
+    programs = [("prefill", engine.prefill_compiled),
+                ("decode", engine.decode_compiled),
+                ("release", engine.release_compiled)]
+    if getattr(engine, "verify_compiled", None) is not None:
+        programs.append(("verify", engine.verify_compiled))
+    for name, compiled in programs:
         findings += check_donation(
             compiled, expected_donated=n, min_alias_bytes=nbytes,
             label=f"ServingEngine.{name}")
     findings += check_collective_placement(
         engine.decode_traced, axes=None, label="ServingEngine.decode")
+    if getattr(engine, "verify_traced", None) is not None:
+        findings += check_collective_placement(
+            engine.verify_traced, axes=None, label="ServingEngine.verify")
     return findings
 
 

@@ -98,11 +98,13 @@ ANNOTATIONS = {
         "apex_tpu/transformer/tensor_parallel/layers.py"],
     "tp_row_linear": [
         "apex_tpu/transformer/tensor_parallel/layers.py"],
-    # serving fast path: the decode kernel plus the two AOT step bodies,
-    # so pyprof attributes prefill vs decode (docs/SERVING.md)
+    # serving fast path: the decode kernel plus the AOT step bodies,
+    # so pyprof attributes prefill vs decode vs speculative verify
+    # (docs/SERVING.md)
     "decode_attention": ["apex_tpu/ops/flash_attention.py"],
     "serve_prefill": ["apex_tpu/serving/engine.py"],
     "serve_decode": ["apex_tpu/serving/engine.py"],
+    "serve_verify": ["apex_tpu/serving/engine.py"],
 }
 
 
@@ -628,47 +630,63 @@ def _class_init_params(path: str, class_name: str):
 
 def _check_decode_configs(repo: str, bench_path: str, findings: list,
                           notes: list):
-    """The paged serving legs: ``BENCH_DECODE_CONFIGS`` keys must be
-    real ``PagedServingEngine.__init__`` parameters — bench.py builds
-    the engine by ``**spec``, so an unknown key would TypeError only at
-    bench runtime (and a renamed engine knob would silently strand the
-    leg)."""
+    """The serving legs: ``BENCH_DECODE_CONFIGS`` keys must be real
+    engine-constructor parameters — bench.py builds the engine by
+    ``**spec``, so an unknown key would TypeError only at bench runtime
+    (and a renamed engine knob would silently strand the leg). Legs
+    carrying block-pool keys validate against
+    ``PagedServingEngine.__init__``; dense legs (the speculative A/B)
+    against ``ServingEngine.__init__``. A leg that states
+    ``speculate_k`` must state it >= 1 — ``speculate_k=0`` would
+    silently bench the non-speculative path against itself."""
     engine_path = os.path.join(repo, PACKAGE, "serving", "engine.py")
     try:
-        allowed = _class_init_params(engine_path, "PagedServingEngine")
+        paged_allowed = _class_init_params(engine_path,
+                                           "PagedServingEngine")
+        dense_allowed = _class_init_params(engine_path, "ServingEngine")
         table = _literal_assign(bench_path, "BENCH_DECODE_CONFIGS")
     except (OSError, SyntaxError, ValueError) as e:
         findings.append(Finding("ast-bench-configs", "MISSING",
                                 "bench.py BENCH_DECODE_CONFIGS", str(e)))
         return
-    if allowed is None:
+    if paged_allowed is None or dense_allowed is None:
         findings.append(Finding(
             "ast-bench-configs", "MISSING", "serving/engine.py",
-            "no PagedServingEngine.__init__ to validate "
+            "no PagedServingEngine/ServingEngine.__init__ to validate "
             "BENCH_DECODE_CONFIGS against"))
         return
     if table is None:
         findings.append(Finding(
             "ast-bench-configs", "MISSING", "bench.py",
-            "no literal BENCH_DECODE_CONFIGS table (the paged decode "
+            "no literal BENCH_DECODE_CONFIGS table (the serving decode "
             "legs must state their engine config declaratively)"))
         return
     for leg, spec in table.items():
         where = f"bench.py BENCH_DECODE_CONFIGS[{leg!r}]"
-        bad = [k for k in spec
-               if k not in allowed] if isinstance(spec, dict) else None
-        if bad is None:
+        if not isinstance(spec, dict):
             findings.append(Finding(
                 "ast-bench-configs", "UNKNOWN", where,
                 f"expected a dict of engine kwargs, got "
                 f"{type(spec).__name__}"))
-        elif bad:
+            continue
+        paged = bool(set(spec) - dense_allowed)
+        allowed = paged_allowed if paged else dense_allowed
+        engine = "PagedServingEngine" if paged else "ServingEngine"
+        bad = [k for k in spec if k not in allowed]
+        if bad:
             findings.append(Finding(
                 "ast-bench-configs", "UNKNOWN", where,
-                f"{bad} are not PagedServingEngine.__init__ "
-                f"parameters"))
-        else:
-            notes.append(f"ok       {where}: {len(spec)} keys")
+                f"{bad} are not {engine}.__init__ parameters"))
+            continue
+        sk = spec.get("speculate_k")
+        if sk is not None and (not isinstance(sk, int) or sk < 1):
+            findings.append(Finding(
+                "ast-bench-configs", "UNKNOWN", where,
+                f"speculate_k={sk!r}: a speculative leg must state a "
+                "static draft window >= 1 (0 benches the "
+                "non-speculative path against itself)"))
+            continue
+        notes.append(f"ok       {where}: {len(spec)} keys ({engine})")
 
 
 def _check_decode_slo(bench_path: str, findings: list, notes: list):

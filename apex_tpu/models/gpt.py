@@ -758,6 +758,142 @@ class GPTModel:
                                     jnp.asarray(block_ids, jnp.int32),
                                     jnp.asarray(offsets, jnp.int32))
 
+    # -- serving: speculative k-token verify --------------------------------
+
+    def _verify_embed(self, params, tokens, lengths):
+        """Embed ``tokens (S, Q)`` at positions ``lengths + [0..Q)`` —
+        row i of the verify window sits where sequential decode step i
+        would have put it."""
+        cfg = self.cfg
+        Q = tokens.shape[1]
+        with jax.named_scope("gpt_embed"):
+            h = self.embedding(params["embedding"]["word"], tokens)
+            positions = lengths[:, None] + jnp.arange(Q)[None, :]
+            pos = jnp.take(
+                params["embedding"]["position"],
+                jnp.clip(positions, 0, cfg.max_position_embeddings - 1),
+                axis=0)                                # (S, Q, hidden)
+            return (h + pos).astype(cfg.compute_dtype)
+
+    def _verify_qkv(self, lp, h):
+        """(S, Q, 3*hidden) -> rank-4 ``q, k_new, v_new`` (S, H, Q, D)
+        plus their cache store+load images for the cross-draft merge."""
+        cfg = self.cfg
+        from apex_tpu.serving.cache import store_roundtrip
+        qkv, _ = self.qkv(lp["qkv"], h)
+        S, Q = qkv.shape[:2]
+        qkv = qkv.reshape(S, Q, cfg.num_attention_heads,
+                          3 * cfg.head_dim).transpose(0, 2, 1, 3)
+        return jnp.split(qkv, 3, axis=-1), store_roundtrip
+
+    def _verify_layer(self, lp: dict, x: jnp.ndarray, layer_cache,
+                      lengths: jnp.ndarray):
+        """One layer of the dense VERIFY step: like :meth:`_decode_layer`
+        but ``x`` is ``(S, Q, hidden)`` — the last accepted token plus
+        the in-flight drafts — scored against the cached prefix in one
+        kernel pass; causality among the Q rows is the exact LSE merge
+        inside :func:`decode_attention`, fed the cache-dtype store+load
+        images so the numerics match Q sequential steps."""
+        cfg = self.cfg
+        h = self._ln(lp["ln1"], x)
+        with jax.named_scope("gpt_attention"):
+            (q, k_new, v_new), roundtrip = self._verify_qkv(lp, h)
+            ck, cv, ksc, vsc = layer_cache
+            quantized = ksc is not None
+            ctx = decode_attention(
+                q, ck, cv, lengths, k_new=k_new, v_new=v_new,
+                k_scale=ksc, v_scale=vsc, use_pallas=cfg.use_flash,
+                k_cast=roundtrip(k_new, ck.dtype, quantized),
+                v_cast=roundtrip(v_new, ck.dtype, quantized))
+            S, _, Q, _ = ctx.shape
+            out, _ = self.proj(lp["proj"],
+                               ctx.transpose(0, 2, 1, 3).reshape(S, Q, -1))
+        x = x + out
+        x = x + self._mlp(lp, self._ln(lp["ln2"], x))
+        return x, (k_new, v_new)
+
+    def _paged_verify_layer(self, lp: dict, x: jnp.ndarray, layer_pool,
+                            block_tables: jnp.ndarray,
+                            lengths: jnp.ndarray,
+                            mean_context: Optional[float]):
+        """One layer of the PAGED verify step: the bounded block-table
+        fetch of :meth:`_paged_decode_layer`, amortized over Q rows."""
+        cfg = self.cfg
+        h = self._ln(lp["ln1"], x)
+        with jax.named_scope("gpt_attention"):
+            (q, k_new, v_new), roundtrip = self._verify_qkv(lp, h)
+            kp, vp, ksc, vsc = layer_pool
+            quantized = ksc is not None
+            ctx = paged_decode_attention(
+                q, kp, vp, block_tables, lengths, k_new=k_new,
+                v_new=v_new, k_scale=ksc, v_scale=vsc,
+                mean_context=mean_context, use_pallas=cfg.use_flash,
+                k_cast=roundtrip(k_new, kp.dtype, quantized),
+                v_cast=roundtrip(v_new, kp.dtype, quantized))
+            S, _, Q, _ = ctx.shape
+            out, _ = self.proj(lp["proj"],
+                               ctx.transpose(0, 2, 1, 3).reshape(S, Q, -1))
+        x = x + out
+        x = x + self._mlp(lp, self._ln(lp["ln2"], x))
+        return x, (k_new, v_new)
+
+    def verify_forward(self, params: dict, tokens: jnp.ndarray, kv_cache,
+                       block_tables: Optional[jnp.ndarray] = None,
+                       lengths: Optional[jnp.ndarray] = None,
+                       cow_src: Optional[jnp.ndarray] = None,
+                       cow_dst: Optional[jnp.ndarray] = None,
+                       mean_context: Optional[float] = None):
+        """Speculative verify: score ``tokens (max_seqs, Q)`` — each
+        slot's last accepted token plus its ``Q - 1`` drafts — in ONE
+        pass over the cached prefix. Returns ``(logits (S, Q, vocab),
+        (k_new, v_new) (L, S, H, Q, D), cache)`` — the cache comes back
+        WITHOUT the window appended (for the paged pool it has only the
+        COW pairs resolved): the engine decides the accepted counts from
+        the logits first and then appends via ``append_k``, all inside
+        the same AOT program. Dense caches read ``kv_cache.lengths``;
+        the paged pool takes the host table/cursor mirrors like the
+        decode leg."""
+        self._require_cacheable()
+        cfg = self.cfg
+        if tokens.ndim != 2:
+            raise ValueError(f"verify tokens must be (max_seqs, Q), got "
+                             f"{tokens.shape}")
+        from apex_tpu.serving.cache import PagedKVCache
+        paged = isinstance(kv_cache, PagedKVCache)
+        if paged:
+            if block_tables is None or lengths is None:
+                raise ValueError("paged verify needs block_tables and "
+                                 "lengths")
+            lengths = jnp.asarray(lengths, jnp.int32)
+            # copy-on-write FIRST — same sequencing as the decode leg
+            if cow_src is not None:
+                kv_cache = kv_cache.cow_copy(
+                    jnp.asarray(cow_src, jnp.int32),
+                    jnp.asarray(cow_dst, jnp.int32))
+        else:
+            lengths = kv_cache.lengths
+        x = self._verify_embed(params, tokens, lengths)
+
+        xs = (params["layers"], kv_cache.k, kv_cache.v)
+        if kv_cache.quantized:
+            xs = xs + (kv_cache.k_scale, kv_cache.v_scale)
+
+        def body(x, lp_c):
+            lp, ck, cv = lp_c[:3]
+            ksc, vsc = (lp_c[3], lp_c[4]) if kv_cache.quantized else \
+                (None, None)
+            if paged:
+                return self._paged_verify_layer(
+                    lp, x, (ck, cv, ksc, vsc), block_tables, lengths,
+                    mean_context)
+            return self._verify_layer(lp, x, (ck, cv, ksc, vsc), lengths)
+
+        x, (k_new, v_new) = scan_stable_vma(body, x, xs,
+                                            unroll=cfg.layer_scan_unroll)
+        x = self._ln(params["final_ln"], x)
+        logits = self.logits(params, x)            # (S, Q, vocab)
+        return logits, (k_new, v_new), kv_cache
+
     def sp_grad_sync(self, grads: dict) -> dict:
         """Megatron-LM allreduces the grads of ``sequence_parallel``-marked
         params (the LayerNorms) in a separate pass

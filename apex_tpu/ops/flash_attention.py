@@ -1033,7 +1033,7 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, ksc_ref, vsc_ref, o_ref,
     # pipeline still fetches them — see the section comment)
     @pl.when(j * block_k < length)
     def _():
-        q = q_ref[0].astype(jnp.float32)          # (1, d)
+        q = q_ref[0].astype(jnp.float32)          # (q_len, d)
         k = k_ref[0]                              # (block_k, d)
         v = v_ref[0]
         if ksc_ref is not None:
@@ -1072,10 +1072,17 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, ksc_ref, vsc_ref, o_ref,
 
 def _decode_pallas(q3, k3, v3, lengths_bh, ksc, vsc, *, scale, block_k):
     bh, T, d = k3.shape
+    # q3 is (bh, q_len, d): q_len == 1 is the classic decode step; the
+    # speculative verify path rides q_len == k drafts + 1 bonus row
+    # through the SAME kernel body (every reduction in it is already
+    # per-row) — only the block/scratch shapes widen. All q rows share
+    # one prefix mask (the drafts are NOT in the cache; causality among
+    # them is the caller's exact merge, _merge_drafts).
+    q_len = q3.shape[1]
     n_kv = T // block_k
     has_scale = ksc is not None
 
-    q_spec = pl.BlockSpec((1, 1, d), lambda b, j: (b, 0, 0),
+    q_spec = pl.BlockSpec((1, q_len, d), lambda b, j: (b, 0, 0),
                           memory_space=pltpu.VMEM)
     kv_spec = pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0),
                            memory_space=pltpu.VMEM)
@@ -1106,13 +1113,13 @@ def _decode_pallas(q3, k3, v3, lengths_bh, ksc, vsc, *, scale, block_k):
         grid=(bh, n_kv),
         in_specs=in_specs,
         out_specs=(q_spec,
-                   pl.BlockSpec((1, 1, 1), lambda b, j: (b, 0, 0),
+                   pl.BlockSpec((1, q_len, 1), lambda b, j: (b, 0, 0),
                                 memory_space=pltpu.VMEM)),
-        out_shape=(jax.ShapeDtypeStruct((bh, 1, d), out_dtype),
-                   jax.ShapeDtypeStruct((bh, 1, 1), jnp.float32)),
-        scratch_shapes=[pltpu.VMEM((1, d), jnp.float32),
-                        pltpu.VMEM((1, 1), jnp.float32),
-                        pltpu.VMEM((1, 1), jnp.float32)],
+        out_shape=(jax.ShapeDtypeStruct((bh, q_len, d), out_dtype),
+                   jax.ShapeDtypeStruct((bh, q_len, 1), jnp.float32)),
+        scratch_shapes=[pltpu.VMEM((q_len, d), jnp.float32),
+                        pltpu.VMEM((q_len, 1), jnp.float32),
+                        pltpu.VMEM((q_len, 1), jnp.float32)],
         interpret=_interp(),
     )(*args)
     return out, lse
@@ -1141,16 +1148,65 @@ def _merge_current(out, lse, q, k_new, v_new, scale, out_dtype):
     return (merged / (a_old + a_new)[..., None]).astype(out_dtype)
 
 
+def _merge_drafts(out, lse, q, k_new, v_new, k_cast, v_cast, scale,
+                  out_dtype):
+    """Exact (q_len+1)-way logsumexp merge for the speculative verify
+    path: fold the cached-prefix attention ``(out, lse)`` — per draft
+    row — with the q_len IN-FLIGHT tokens' keys/values, causally masked
+    so row i attends rows 0..i (itself plus the earlier drafts). None of
+    the in-flight tokens are in the cache yet; a sequential decode would
+    have round-tripped rows j < i through the cache's storage dtype
+    before row i read them, so the caller passes ``k_cast``/``v_cast``
+    (the store+load images of ``k_new``/``v_new``) and the merge uses
+    those OFF-diagonal while the diagonal (self-attention) stays fresh —
+    exactly the numerics of k single-token steps. Reduces to
+    ``_merge_current`` at q_len == 1.
+
+    Shapes: out/q/k_new/v_new/k_cast/v_cast ``(b, h, q_len, d)``, lse
+    ``(b, h, q_len)``."""
+    q32 = q.astype(jnp.float32)
+    qlen = q.shape[2]
+    # off-diagonal scores against the cache-dtype images; diagonal fresh
+    s_cast = jnp.einsum("bhid,bhjd->bhij", q32,
+                        k_cast.astype(jnp.float32)) * scale
+    s_self = jnp.sum(q32 * k_new.astype(jnp.float32), axis=-1) * scale
+    row = jax.lax.broadcasted_iota(jnp.int32, (qlen, qlen), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (qlen, qlen), 1)
+    below = col < row                              # strictly-earlier drafts
+    s_off = jnp.where(below, s_cast, -jnp.inf)
+    m = jnp.maximum(lse, jnp.maximum(s_self, jnp.max(s_off, axis=-1)))
+    a_old = jnp.exp(lse - m)                       # 0 when prefix empty
+    p_self = jnp.exp(s_self - m)
+    p_off = jnp.where(below, jnp.exp(s_cast - m[..., None]), 0.0)
+    denom = a_old + p_self + jnp.sum(p_off, axis=-1)
+    merged = (a_old[..., None] * out.astype(jnp.float32)
+              + p_self[..., None] * v_new.astype(jnp.float32)
+              + jnp.einsum("bhij,bhjd->bhid", p_off,
+                           v_cast.astype(jnp.float32)))
+    return (merged / denom[..., None]).astype(out_dtype)
+
+
 def decode_attention(q, k, v, lengths, k_new=None, v_new=None,
                      k_scale=None, v_scale=None,
                      softmax_scale: Optional[float] = None,
                      block_k: Optional[int] = None,
-                     use_pallas: Optional[bool] = None):
+                     use_pallas: Optional[bool] = None,
+                     k_cast=None, v_cast=None):
     """Single-query attention over a preallocated KV cache — the serving
     decode kernel (see the section comment above).
 
+    Speculative verify: pass ``q`` as ``(b, h, q_len, d)`` (with matching
+    rank-4 ``k_new``/``v_new``) to score q_len in-flight tokens per slot
+    in ONE cache pass — the kernel prices the cached prefix once for all
+    rows, and causality among the in-flight tokens is an exact LSE merge
+    (``_merge_drafts``). ``k_cast``/``v_cast`` optionally carry the
+    cache-dtype store+load images of ``k_new``/``v_new`` so cross-draft
+    attention reproduces sequential decode's numerics bit-for-bit
+    (default: the fresh values). The return is ``(b, h, q_len, d)``.
+
     Args:
-      q: ``(b, h, d)`` — one query row per sequence slot.
+      q: ``(b, h, d)`` — one query row per sequence slot — or
+        ``(b, h, q_len, d)`` for the verify path.
       k, v: ``(b, h, max_len, d)`` preallocated caches (bf16/fp32, or int8
         with ``k_scale``/``v_scale``). Entries at or past ``lengths`` are
         never read.
@@ -1171,7 +1227,12 @@ def decode_attention(q, k, v, lengths, k_new=None, v_new=None,
     Falls back to the XLA reference (:func:`mha_reference` with its
     ``kv_length`` oracle path) when the cache isn't tile-aligned.
     """
-    b, h, d = q.shape
+    multi = q.ndim == 4
+    if multi:
+        b, h, q_len, d = q.shape
+    else:
+        b, h, d = q.shape
+        q_len = 1
     T = k.shape[2]
     if k.shape != (b, h, T, d) or v.shape != (b, h, T, d):
         raise ValueError(f"cache shapes {k.shape}/{v.shape} do not match "
@@ -1196,6 +1257,46 @@ def decode_attention(q, k, v, lengths, k_new=None, v_new=None,
     lengths = jnp.asarray(lengths).astype(jnp.int32)
 
     with jax.named_scope("decode_attention"):
+        if multi:
+            # verify path: q_len rows per slot, ONE pass over the cached
+            # prefix (the mask is the same for every row — none of the
+            # in-flight tokens are in the cache), then the causal merge
+            if use_pallas:
+                q3 = q.reshape(b * h, q_len, d)
+                k3 = k.reshape(b * h, T, d)
+                v3 = v.reshape(b * h, T, d)
+                lengths_bh = jnp.repeat(lengths, h)
+                ksc = k_scale.reshape(b * h, T) if quantized else None
+                vsc = v_scale.reshape(b * h, T) if quantized else None
+                out3, lse3 = _decode_pallas(q3, k3, v3, lengths_bh, ksc,
+                                            vsc,
+                                            scale=float(softmax_scale),
+                                            block_k=block_k)
+                out = out3.reshape(b, h, q_len, d)
+                lse = lse3.reshape(b, h, q_len)
+            else:
+                kd = _dequant(k, k_scale) if quantized else k
+                vd = _dequant(v, v_scale) if quantized else v
+                s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                               kd.astype(jnp.float32)) * softmax_scale
+                col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, T), 3)
+                valid = col < lengths[:, None, None, None]
+                s = jnp.where(valid, s, NEG_INF)
+                m = jnp.max(s, axis=-1, keepdims=True)
+                p = jnp.where(valid, jnp.exp(s - m), 0.0)
+                l = jnp.sum(p, axis=-1, keepdims=True)
+                safe_l = jnp.where(l == 0.0, 1.0, l)
+                out = jnp.einsum("bhqk,bhkd->bhqd", p / safe_l,
+                                 vd.astype(jnp.float32))
+                lse = jnp.where(lengths[:, None, None] == 0, -jnp.inf,
+                                (m + jnp.log(safe_l))[..., 0])
+            if k_new is not None:
+                out = _merge_drafts(
+                    out, lse, q, k_new, v_new,
+                    k_new if k_cast is None else k_cast,
+                    v_new if v_cast is None else v_cast,
+                    float(softmax_scale), q.dtype)
+            return out.astype(q.dtype)
         if use_pallas:
             q3 = q.reshape(b * h, 1, d)
             k3 = k.reshape(b * h, T, d)
@@ -1296,7 +1397,12 @@ def _paged_decode_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, ksc_ref,
     # the clamped index map (see the section comment)
     @pl.when(j * block_size < length)
     def _():
-        q = q_ref[0].astype(jnp.float32)          # (1, d)
+        # classic decode rides a rank-3 (1, 1, d) q block — the exact
+        # pre-speculation program, kept byte-identical so non-spec
+        # engines never recompile or shift numerics; the verify path
+        # widens to a rank-4 (1, 1, q_len, d) block
+        q = (q_ref[0] if q_ref.ndim == 3
+             else q_ref[0, 0]).astype(jnp.float32)  # (q_len, d)
         k = k_ref[0, 0]                           # (block_size, d)
         v = v_ref[0, 0]
         if ksc_ref is not None:
@@ -1326,18 +1432,29 @@ def _paged_decode_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, ksc_ref,
     def _():
         l = l_ref[:]
         safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
         # -inf on empty rows: the identity of the _merge_current fold
-        lse_ref[0] = jnp.where(l == 0.0, -jnp.inf,
-                               m_ref[:] + jnp.log(safe_l))
+        if o_ref.ndim == 3:
+            o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+            lse_ref[0] = jnp.where(l == 0.0, -jnp.inf,
+                                   m_ref[:] + jnp.log(safe_l))
+        else:
+            o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+            lse_ref[0, 0] = jnp.where(l == 0.0, -jnp.inf,
+                                      m_ref[:] + jnp.log(safe_l))
 
 
 def _paged_cost(s, h, d, kv_dtype, quantized, n_blocks_slot, block_size,
-                mean_context):
+                mean_context, q_len=1):
     """``pl.CostEstimate`` for one paged decode call: the fetch-elided
     HBM bytes at ``mean_context`` tokens of ACTUAL context per slot (the
     index-map clamp makes repeated blocks free), so the pyprof roofline
-    prices what the kernel moves, not the worst-case table span."""
+    prices what the kernel moves, not the worst-case table span.
+
+    ``q_len > 1`` is the speculative verify call: the MXU work and the
+    q/out traffic scale by q_len, but the dominant KV stream does NOT —
+    the cached stripe is fetched once for all q_len rows, which is
+    exactly why the roofline shows the per-token HBM cost dropping ~k×
+    at acceptance."""
     cap = n_blocks_slot * block_size
     ctx = cap if mean_context is None else mean_context
     ctx = float(min(max(ctx, 1), cap))
@@ -1347,21 +1464,41 @@ def _paged_cost(s, h, d, kv_dtype, quantized, n_blocks_slot, block_size,
     kv_bytes = 2.0 * s * h * ctx * d * itemsize
     if quantized:
         kv_bytes += 2.0 * s * h * ctx * 4
-    io_bytes = kv_bytes + 2.0 * s * h * d * 4 + s * (n_blocks_slot + 1) * 4
-    flops = 4.0 * s * h * ctx * d          # qk^T + pv, 2 MACs each
+    io_bytes = (kv_bytes + 2.0 * s * h * q_len * d * 4
+                + s * (n_blocks_slot + 1) * 4)
+    flops = 4.0 * s * h * ctx * d * q_len  # qk^T + pv, 2 MACs each
     return pl.CostEstimate(flops=int(flops), bytes_accessed=int(io_bytes),
-                           transcendentals=int(s * h * ctx))
+                           transcendentals=int(s * h * ctx * q_len))
 
 
 def _paged_decode_pallas(q, kp, vp, tables, lengths, ksc, vsc, *, scale,
                          mean_context):
-    S, h, d = q.shape
+    # q rank-3 (S, h, d) is the classic decode step — its program is
+    # kept BYTE-identical to the pre-speculation kernel (same block
+    # ranks, same index maps) so non-spec engines are untouched; rank-4
+    # (S, h, q_len, d) is the verify path, which only widens the
+    # q/out/scratch shapes — the kernel body is per-row throughout and
+    # the KV fetch sequence (and its clamp) is q_len-independent.
+    multi = q.ndim == 4
+    if multi:
+        S, h, q_len, d = q.shape
+    else:
+        S, h, d = q.shape
+        q_len = 1
     _nb_pool, _, block_size, _ = kp.shape
     n_blocks = tables.shape[1]
     has_scale = ksc is not None
 
-    def q_map(s, hh, j, tabs, lens):
-        return (s, hh, 0)
+    if multi:
+        def q_map(s, hh, j, tabs, lens):
+            return (s, hh, 0, 0)
+        q_block, lse_block = (1, 1, q_len, d), (1, 1, q_len, 1)
+        out_shapes = ((S, h, q_len, d), (S, h, q_len, 1))
+    else:
+        def q_map(s, hh, j, tabs, lens):
+            return (s, hh, 0)
+        q_block, lse_block = (1, 1, d), (1, 1, 1)
+        out_shapes = ((S, h, d), (S, h, 1))
 
     def kv_map(s, hh, j, tabs, lens):
         # clamp past-the-cursor steps to the slot's LAST valid block:
@@ -1380,7 +1517,7 @@ def _paged_decode_pallas(q, kp, vp, tables, lengths, ksc, vsc, *, scale,
         jj = jnp.minimum(j, nb_valid - 1)
         return (tabs[s, jj], hh, 0)
 
-    in_specs = [pl.BlockSpec((1, 1, d), q_map),
+    in_specs = [pl.BlockSpec(q_block, q_map),
                 pl.BlockSpec((1, 1, block_size, d), kv_map),
                 pl.BlockSpec((1, 1, block_size, d), kv_map)]
     args = [q, kp, vp]
@@ -1406,19 +1543,19 @@ def _paged_decode_pallas(q, kp, vp, tables, lengths, ksc, vsc, *, scale,
         num_scalar_prefetch=2,
         grid=(S, h, n_blocks),
         in_specs=in_specs,
-        out_specs=(pl.BlockSpec((1, 1, d), q_map),
-                   pl.BlockSpec((1, 1, 1), q_map)),
-        scratch_shapes=[pltpu.VMEM((1, d), jnp.float32),
-                        pltpu.VMEM((1, 1), jnp.float32),
-                        pltpu.VMEM((1, 1), jnp.float32)])
+        out_specs=(pl.BlockSpec(q_block, q_map),
+                   pl.BlockSpec(lse_block, q_map)),
+        scratch_shapes=[pltpu.VMEM((q_len, d), jnp.float32),
+                        pltpu.VMEM((q_len, 1), jnp.float32),
+                        pltpu.VMEM((q_len, 1), jnp.float32)])
     out_dtype = q.dtype if q.dtype != jnp.int8 else jnp.float32
     out, lse = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=(jax.ShapeDtypeStruct((S, h, d), out_dtype),
-                   jax.ShapeDtypeStruct((S, h, 1), jnp.float32)),
+        out_shape=(jax.ShapeDtypeStruct(out_shapes[0], out_dtype),
+                   jax.ShapeDtypeStruct(out_shapes[1], jnp.float32)),
         cost_estimate=_paged_cost(S, h, d, kp.dtype, has_scale, n_blocks,
-                                  block_size, mean_context),
+                                  block_size, mean_context, q_len=q_len),
         interpret=_interp(),
         name="paged_decode_attention",
     )(tables, lengths, *args)
@@ -1430,12 +1567,21 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, lengths,
                            v_scale=None,
                            softmax_scale: Optional[float] = None,
                            mean_context: Optional[float] = None,
-                           use_pallas: Optional[bool] = None):
+                           use_pallas: Optional[bool] = None,
+                           k_cast=None, v_cast=None):
     """Single-query attention over a PAGED KV cache (see the section
     comment above) — the v2 serving decode kernel.
 
+    Speculative verify: pass ``q`` as ``(b, h, q_len, d)`` (with rank-4
+    ``k_new``/``v_new`` and optional ``k_cast``/``v_cast`` store+load
+    images) to score q_len in-flight tokens per slot against ONE bounded
+    fetch of the cached blocks — the block-table walk and its clamp are
+    q_len-independent, so the per-token HBM cost drops ~q_len× at full
+    acceptance. Returns ``(b, h, q_len, d)``.
+
     Args:
-      q: ``(b, h, d)`` — one query row per sequence slot.
+      q: ``(b, h, d)`` — one query row per sequence slot — or
+        ``(b, h, q_len, d)`` for the verify path.
       k_pool, v_pool: ``(num_blocks, h, block_size, d)`` global block
         pools (bf16/fp32, or int8 with pooled scales). Only the blocks a
         slot's table names are ever read for it.
@@ -1459,7 +1605,12 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, lengths,
     Falls back to a gather-then-reference XLA path (same math, priced
     O(table span)) when the pool isn't tile-aligned for Pallas.
     """
-    b, h, d = q.shape
+    multi = q.ndim == 4
+    if multi:
+        b, h, q_len, d = q.shape
+    else:
+        b, h, d = q.shape
+        q_len = 1
     nb_pool, hp, block_size, dp = k_pool.shape
     if v_pool.shape != k_pool.shape or hp != h or dp != d:
         raise ValueError(f"pool shapes {k_pool.shape}/{v_pool.shape} do "
@@ -1484,11 +1635,21 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, lengths,
 
     with jax.named_scope("decode_attention"):
         if use_pallas:
+            # rank-3 q emits the classic (byte-identical) decode
+            # program; rank-4 q emits the widened verify program
             out, lse = _paged_decode_pallas(
                 q, k_pool, v_pool, block_tables, lengths,
                 k_scale if quantized else None,
                 v_scale if quantized else None,
                 scale=float(softmax_scale), mean_context=mean_context)
+            if multi:
+                if k_new is not None:
+                    out = _merge_drafts(
+                        out, lse, q, k_new, v_new,
+                        k_new if k_cast is None else k_cast,
+                        v_new if v_cast is None else v_cast,
+                        float(softmax_scale), q.dtype)
+                return out.astype(q.dtype)
             if k_new is not None:
                 out = _merge_current(out, lse, q, k_new, v_new,
                                      float(softmax_scale), q.dtype)
@@ -1512,4 +1673,5 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, lengths,
         return decode_attention(q, kd, vd, lengths, k_new=k_new,
                                 v_new=v_new, k_scale=ksc, v_scale=vsc,
                                 softmax_scale=softmax_scale,
-                                use_pallas=False)
+                                use_pallas=False, k_cast=k_cast,
+                                v_cast=v_cast)

@@ -68,8 +68,9 @@ DEFAULT_REGIONS: Tuple[str, ...] = (
     "pipeline_tick", "optimizer_step",
     # serving fast path: the decode kernel carves out of gpt_attention;
     # the step scopes catch the non-model work (sampling, cache append)
-    # and split prefill from decode programs in a combined trace
-    "decode_attention", "serve_prefill", "serve_decode",
+    # and split prefill from decode from speculative verify programs in
+    # a combined trace
+    "decode_attention", "serve_prefill", "serve_decode", "serve_verify",
 )
 
 UNATTRIBUTED = "(unattributed)"

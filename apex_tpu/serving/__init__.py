@@ -25,6 +25,15 @@ prefix-hash sharing, copy-on-write) driven by
 traffic O(actual context) instead of O(max_len), admission reserves
 blocks instead of whole ``max_len`` slots, and shared prompt prefixes
 skip their prefill.
+
+Speculative decoding (docs/SERVING.md "Speculative decoding"): both
+engines compile a fourth AOT ``verify`` program at
+``speculate_k=k`` that scores a slot's last token plus ``k`` host-drafted
+tokens (:class:`~apex_tpu.serving.scheduler.NGramDraftSource`, a
+:class:`~apex_tpu.serving.scheduler.DraftSource`) in one pass and
+appends the window with a k-token cache write — 1 to ``k + 1`` tokens
+per step at one step's HBM cost, greedy streams bitwise-identical to
+non-speculative greedy.
 """
 
 from apex_tpu.observability.reqtrace import (RequestRecord, RequestTrace,
@@ -40,13 +49,16 @@ from apex_tpu.serving.resilience import (REJECTION_REASONS,
                                          BrownoutPolicy,
                                          CheckpointWatcher, Rejection,
                                          watch_checkpoints)
-from apex_tpu.serving.sampling import sample_tokens
-from apex_tpu.serving.scheduler import Completion, Request, SlotScheduler
+from apex_tpu.serving.sampling import sample_tokens, verify_tokens
+from apex_tpu.serving.scheduler import (Completion, DraftSource,
+                                        NGramDraftSource, Request,
+                                        SlotScheduler)
 
 __all__ = ["KVCache", "cache_bytes_per_slot", "ServingEngine",
            "PagedKVCache", "BlockAllocator", "AdmitPlan", "StepPlan",
            "PoolExhausted", "paged_block_bytes", "PagedServingEngine",
-           "sample_tokens", "Completion", "Request", "SlotScheduler",
+           "sample_tokens", "verify_tokens", "Completion", "Request",
+           "SlotScheduler", "DraftSource", "NGramDraftSource",
            "RequestRecord", "RequestTrace", "chrome_request_trace",
            "SLOTarget", "SLOTracker", "SLOViolationError",
            "Rejection", "REJECTION_REASONS", "BrownoutPolicy",

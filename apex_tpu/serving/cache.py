@@ -54,7 +54,7 @@ import numpy as np
 
 __all__ = ["KVCache", "cache_bytes_per_slot", "PagedKVCache",
            "BlockAllocator", "AdmitPlan", "StepPlan", "PoolExhausted",
-           "paged_block_bytes"]
+           "paged_block_bytes", "store_roundtrip"]
 
 # floor for the absmax quantization scale: keeps an all-zero row (e.g. a
 # never-written slot) from producing 0/0 at dequantization
@@ -69,6 +69,20 @@ def _quantize(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         _MIN_SCALE)
     q = jnp.round(x.astype(jnp.float32) / scale[..., None])
     return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+
+
+def store_roundtrip(x: jnp.ndarray, cache_dtype,
+                    quantized: bool) -> jnp.ndarray:
+    """The store+load image of ``x``: exactly what a later step would
+    read back after this cache appended ``x`` (dtype cast, or int8
+    quantize + fp32 dequantize). The speculative verify path feeds this
+    to the attention merge for cross-draft keys/values, so one k-token
+    verify step reproduces the numerics of k single-token steps — the
+    greedy bitwise-stream contract rides on it."""
+    if quantized:
+        q, scale = _quantize(x)
+        return q.astype(jnp.float32) * scale[..., None]
+    return x.astype(cache_dtype)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -213,6 +227,71 @@ class KVCache:
             new["v_scale"] = jax.vmap(upd_sc, in_axes=(1, 1, 0, 0),
                                       out_axes=1)(self.v_scale, vs, pos,
                                                   writable)
+        return dataclasses.replace(self, **new)
+
+    def append_k(self, k_new: jnp.ndarray, v_new: jnp.ndarray,
+                 counts: jnp.ndarray) -> "KVCache":
+        """Speculative verify append: write a WINDOW of up to ``K``
+        tokens per slot at its cursor in one batched DUS per array —
+        ``k_new``/``v_new`` are ``(L, S, H, K, D)`` (row i belongs at
+        position ``cursor + i``) and ``counts`` ``(S,)`` int32 is each
+        slot's cursor advance (accepted drafts + 1; 0 for
+        inactive/failed slots). Every row that FITS below ``max_len`` is
+        written — rows past the accepted count hold drafted-but-rejected
+        KV, which lands ABOVE the advanced cursor where no read ever
+        masks it in and the next step's window overwrites it. That is
+        the whole mid-verify rollback story: the cursor only ever moves
+        by the accepted count, so retiring a slot at ANY point (deadline,
+        poison) can never strand rejected entries below it (negative
+        test in ``tests/test_speculative.py``). Near saturation the
+        window clamps: rows that would land at or past ``max_len`` are
+        dropped and positions below the cursor are written back
+        unchanged; a slot AT ``max_len`` writes nothing."""
+        L, H, D = self.num_layers, self.num_heads, self.head_dim
+        T = self.max_len
+        K = k_new.shape[3]
+        if K > T:
+            raise ValueError(f"verify window {K} exceeds max_len {T}")
+        start = jnp.minimum(self.lengths, T - K)
+        # >0 only near saturation: the window slid back so it fits, and
+        # row r of the new KV sits at window offset r + shift
+        shift = self.lengths - start
+        w = jnp.arange(K)
+
+        def upd(cache_s, new_s, st, sh):
+            # per-slot: (L, H, T, D) window <- (L, H, K, D) at st
+            old = jax.lax.dynamic_slice(cache_s, (0, 0, st, 0),
+                                        (L, H, K, D))
+            r = w - sh
+            rows = jnp.take(new_s, jnp.clip(r, 0, K - 1), axis=2)
+            vals = jnp.where((r >= 0)[None, None, :, None], rows, old)
+            return jax.lax.dynamic_update_slice(cache_s, vals,
+                                                (0, 0, st, 0))
+
+        kq, ks = self._store(k_new)
+        vq, vs = self._store(v_new)
+        k = jax.vmap(upd, in_axes=(1, 1, 0, 0), out_axes=1)(
+            self.k, kq, start, shift)
+        v = jax.vmap(upd, in_axes=(1, 1, 0, 0), out_axes=1)(
+            self.v, vq, start, shift)
+        advanced = jnp.minimum(
+            self.lengths + jnp.asarray(counts, jnp.int32), T)
+        new = {"k": k, "v": v, "lengths": advanced}
+        if self.quantized:
+            def upd_sc(sc_s, new_s, st, sh):
+                old = jax.lax.dynamic_slice(sc_s, (0, 0, st), (L, H, K))
+                r = w - sh
+                rows = jnp.take(new_s, jnp.clip(r, 0, K - 1), axis=2)
+                vals = jnp.where((r >= 0)[None, None, :], rows, old)
+                return jax.lax.dynamic_update_slice(sc_s, vals,
+                                                    (0, 0, st))
+
+            new["k_scale"] = jax.vmap(upd_sc, in_axes=(1, 1, 0, 0),
+                                      out_axes=1)(self.k_scale, ks,
+                                                  start, shift)
+            new["v_scale"] = jax.vmap(upd_sc, in_axes=(1, 1, 0, 0),
+                                      out_axes=1)(self.v_scale, vs,
+                                                  start, shift)
         return dataclasses.replace(self, **new)
 
     def write_prompt(self, k_new: jnp.ndarray, v_new: jnp.ndarray,
@@ -383,6 +462,43 @@ class PagedKVCache:
                 jnp.transpose(ks, (1, 0, 2)), mode="drop")
             new["v_scale"] = self.v_scale.at[:, block_ids, :, offsets].set(
                 jnp.transpose(vs, (1, 0, 2)), mode="drop")
+        return dataclasses.replace(self, **new)
+
+    def append_k(self, k_new: jnp.ndarray, v_new: jnp.ndarray,
+                 block_ids: jnp.ndarray,
+                 offsets: jnp.ndarray) -> "PagedKVCache":
+        """Speculative verify append: up to ``K`` tokens per slot —
+        ``k_new``/``v_new`` are ``(L, S, H, K, D)`` and
+        ``block_ids``/``offsets`` ``(S, K)`` int32 name each token's
+        pool block and in-block position (HOST-computed by
+        :meth:`BlockAllocator.verify_targets`; the window may CROSS a
+        block boundary, which is why the ids are per-token, not
+        per-slot). Masked tokens — inactive slots, rows past capacity —
+        aim at the null block. One batched scatter per array, in-place
+        on donated buffers; the cursor mirror advances host-side by the
+        ACCEPTED count only (:meth:`BlockAllocator.advance_counts`), so
+        rejected rows land in slot-private blocks above the cursor."""
+        S, K = block_ids.shape
+        bid = block_ids.reshape(S * K)
+        off = offsets.reshape(S * K)
+        kq, ks = self._store(k_new)
+        vq, vs = self._store(v_new)
+
+        def scatter(pool, x):
+            # (L, S, H, K, D) -> (S*K, L, H, D): the two advanced
+            # indices are split by a slice, so update dims lead
+            upd = jnp.transpose(x, (1, 3, 0, 2, 4)).reshape(
+                S * K, x.shape[0], x.shape[2], x.shape[4])
+            return pool.at[:, bid, :, off, :].set(upd, mode="drop")
+
+        new = {"k": scatter(self.k, kq), "v": scatter(self.v, vq)}
+        if self.quantized:
+            def scatter_sc(pool, sc):
+                upd = jnp.transpose(sc, (1, 3, 0, 2)).reshape(
+                    S * K, sc.shape[0], sc.shape[2])
+                return pool.at[:, bid, :, off].set(upd, mode="drop")
+            new["k_scale"] = scatter_sc(self.k_scale, ks)
+            new["v_scale"] = scatter_sc(self.v_scale, vs)
         return dataclasses.replace(self, **new)
 
     def write_prompt_blocks(self, k_new: jnp.ndarray, v_new: jnp.ndarray,
@@ -720,6 +836,26 @@ class BlockAllocator:
         return bid.astype(np.int32), (cur % self.block_size).astype(
             np.int32)
 
+    def verify_targets(self, active: np.ndarray, k: int):
+        """``(block_ids, offsets)`` ``(S, k)`` int32 for a k-token
+        verify append: ACTIVE slot ``s`` writes token ``i`` at cursor
+        position ``cursor + i`` — a window that may cross a block
+        boundary, so each token names its own (block, offset) pair.
+        Inactive slots and positions past capacity aim at the null
+        block. :meth:`prepare_verify` must have mapped the touched
+        blocks first."""
+        cur = self.lengths[:, None].astype(np.int64)
+        pos = cur + np.arange(k)[None, :]                       # (S, k)
+        bidx = np.minimum(pos // self.block_size,
+                          self.blocks_per_slot - 1)
+        bid = np.take_along_axis(self.tables, bidx.astype(np.intp),
+                                 axis=1).copy()
+        ok = np.asarray(active, bool)[:, None] & \
+            (pos < self.capacity_tokens)
+        bid[~ok] = NULL_BLOCK
+        return bid.astype(np.int32), (pos % self.block_size).astype(
+            np.int32)
+
     def prepare_step(self, active_slots: Sequence[int]) -> StepPlan:
         """Make every active slot writable for ONE append: resolve any
         COW whose block the cursor is about to enter (allocate the
@@ -727,6 +863,21 @@ class BlockAllocator:
         and allocate a fresh block where the cursor crossed into an
         unmapped table entry. Slots the pool cannot serve land in
         ``failed`` — the scheduler retires them loudly."""
+        return self.prepare_verify(active_slots, 1)
+
+    def prepare_verify(self, active_slots: Sequence[int],
+                       k: int) -> StepPlan:
+        """:meth:`prepare_step` generalized to a k-token verify window:
+        every block the window ``[cursor, cursor + k)`` touches — up to
+        ``ceil(k/block_size) + 1`` table entries — is made slot-private
+        and writable BEFORE the step: the cursor block's pending COW is
+        resolved (rejected drafts must never scribble a shared block)
+        and unmapped entries get fresh blocks. Allocation is atomic per
+        slot: a slot the pool cannot fully serve rolls its partial
+        grab back and lands in ``failed``. Blocks mapped for rows the
+        verify then REJECTS stay mapped — they sit above the advanced
+        cursor and the next window reuses them; release() frees them
+        with the rest of the row."""
         cow_src = np.zeros(self.max_seqs, np.int32)
         cow_dst = np.zeros(self.max_seqs, np.int32)
         failed: List[int] = []
@@ -735,17 +886,19 @@ class BlockAllocator:
             if cur >= self.capacity_tokens:
                 failed.append(slot)
                 continue
-            bidx = cur // self.block_size
+            first = cur // self.block_size
+            last = min((cur + k - 1) // self.block_size,
+                       self.blocks_per_slot - 1)
             pend = self._cow_pending.get(slot)
-            if pend is not None and pend == bidx:
-                old = int(self.tables[slot, bidx])
+            if pend is not None and pend == first:
+                old = int(self.tables[slot, first])
                 try:
                     new = self._take_block()
                 except PoolExhausted:
                     failed.append(slot)
                     continue
                 self.refcount[new] = 1
-                self.tables[slot, bidx] = new
+                self.tables[slot, first] = new
                 cow_src[slot] = old
                 cow_dst[slot] = new
                 # the device copies old -> new THIS step before any
@@ -754,19 +907,40 @@ class BlockAllocator:
                 self._release_block(old)
                 del self._cow_pending[slot]
                 self.cow_copies += 1
-                continue
-            if self.tables[slot, bidx] == NULL_BLOCK:
+            taken: List[int] = []
+            short = False
+            for bidx in range(first, last + 1):
+                if self.tables[slot, bidx] != NULL_BLOCK:
+                    continue
                 try:
                     new = self._take_block()
                 except PoolExhausted:
-                    failed.append(slot)
-                    continue
+                    short = True
+                    break
                 self.refcount[new] = 1
                 self.tables[slot, bidx] = new
+                taken.append(bidx)
+            if short:
+                # atomic per slot: hand the partial grab back so a
+                # sibling slot (or the next step) can use it
+                for bidx in taken:
+                    b = int(self.tables[slot, bidx])
+                    self.tables[slot, bidx] = NULL_BLOCK
+                    self._release_block(b)
+                failed.append(slot)
         return StepPlan(cow_src, cow_dst, failed)
 
     def advance(self, slots: Sequence[int]) -> None:
         """Cursor mirror +1 for the slots whose append just landed."""
         for slot in slots:
             self.lengths[slot] = min(int(self.lengths[slot]) + 1,
+                                     self.capacity_tokens)
+
+    def advance_counts(self, slots: Sequence[int],
+                       counts: Sequence[int]) -> None:
+        """Cursor mirror advance by each slot's ACCEPTED verify count —
+        the rejected tail of the window stays above the cursor, invisible
+        to every read."""
+        for slot, n in zip(slots, counts):
+            self.lengths[slot] = min(int(self.lengths[slot]) + int(n),
                                      self.capacity_tokens)

@@ -45,7 +45,7 @@ from apex_tpu.observability.costs import memory_budget
 from apex_tpu.serving.cache import (KVCache, PagedKVCache, BlockAllocator,
                                     AdmitPlan, PoolExhausted,
                                     cache_bytes_per_slot, paged_block_bytes)
-from apex_tpu.serving.sampling import sample_tokens
+from apex_tpu.serving.sampling import sample_tokens, verify_tokens
 
 __all__ = ["ServingEngine", "PagedServingEngine"]
 
@@ -76,12 +76,24 @@ class ServingEngine:
         the scheduler's quarantine reads. Default off — the decode
         program is byte-identical to a quarantine-free engine's (the
         PR 3 zero-cost idiom, asserted in ``tests/test_resilience.py``).
+      speculate_k: when > 0, compile a FOURTH AOT program — ``verify``
+        — that scores each slot's last accepted token plus ``k``
+        drafted tokens in ONE pass over the cached prefix
+        (:meth:`~apex_tpu.models.gpt.GPTModel.verify_forward`), runs
+        the acceptance rule
+        (:func:`~apex_tpu.serving.sampling.verify_tokens`) and appends
+        the whole window with a k-token cache write
+        (:meth:`~apex_tpu.serving.cache.KVCache.append_k`). ``k`` is
+        the only static knob; draft tokens, temperatures and the
+        active mask are array arguments, so speculative serving keeps
+        the zero-recompile contract. Default 0 — the engine is
+        byte-identical to a pre-speculation one.
     """
 
     def __init__(self, model, params, *, max_seqs: int, max_len: int,
                  prefill_len: int, cache_dtype=jnp.bfloat16,
                  top_k: int = 0, rng_seed: int = 0,
-                 quarantine: bool = False):
+                 quarantine: bool = False, speculate_k: int = 0):
         model._require_cacheable()
         cfg = model.cfg
         if max_len > cfg.max_position_embeddings:
@@ -98,6 +110,13 @@ class ServingEngine:
         self.prefill_len = int(prefill_len)
         self.top_k = int(top_k)
         self.quarantine = bool(quarantine)
+        self.speculate_k = int(speculate_k)
+        if self.speculate_k < 0:
+            raise ValueError(f"speculate_k must be >= 0, got {speculate_k}")
+        if self.speculate_k + 1 > max_len:
+            raise ValueError(
+                f"speculate_k {speculate_k} needs a {speculate_k + 1}-token "
+                f"verify window, which exceeds max_len {max_len}")
         self.last_finite: Optional[np.ndarray] = None
         self.swaps = 0
         self.cache = KVCache.create(
@@ -169,6 +188,56 @@ class ServingEngine:
         self.decode_traced = jax.jit(
             decode_step, donate_argnums=(1,)).trace(*decode_args)
         self.decode_compiled = self.decode_traced.lower().compile()
+
+        self.verify_traced = None
+        self.verify_compiled = None
+        if self.speculate_k > 0:
+            K = self.speculate_k
+
+            def _verify_core(params, cache, tokens, drafts, temperature,
+                             active, rng, poison=None):
+                # score the whole window BEFORE appending: the accepted
+                # count decides the cursor advance, and append_k writes
+                # every row that fits — rejected rows land above the
+                # cursor, masked from every read (the rollback story)
+                logits, (k_new, v_new), cache = model.verify_forward(
+                    params, tokens, cache)
+                finite = None
+                if poison is not None:
+                    logits = logits + poison[:, None, None]
+                    finite = jnp.all(jnp.isfinite(logits), axis=(-2, -1))
+                toks, accepted = verify_tokens(logits, drafts, rng,
+                                               temperature, self.top_k)
+                counts = jnp.where(active, accepted + 1, 0)
+                cache = cache.append_k(k_new, v_new, counts)
+                if finite is not None:
+                    return cache, toks, counts, finite
+                return cache, toks, counts
+
+            if self.quarantine:
+                def verify_step(params, cache, tokens, drafts,
+                                temperature, active, rng, poison):
+                    with jax.named_scope("serve_verify"):
+                        return _verify_core(params, cache, tokens, drafts,
+                                            temperature, active, rng,
+                                            poison)
+            else:
+                def verify_step(params, cache, tokens, drafts,
+                                temperature, active, rng):
+                    with jax.named_scope("serve_verify"):
+                        return _verify_core(params, cache, tokens, drafts,
+                                            temperature, active, rng)
+
+            verify_args = (params, self.cache,
+                           jnp.zeros((S, K + 1), jnp.int32),
+                           jnp.zeros((S, K), jnp.int32),
+                           jnp.zeros((S,), jnp.float32),
+                           jnp.ones((S,), jnp.bool_), self._key)
+            if self.quarantine:
+                verify_args += (self._zero_poison,)
+            self.verify_traced = jax.jit(
+                verify_step, donate_argnums=(1,)).trace(*verify_args)
+            self.verify_compiled = self.verify_traced.lower().compile()
 
         def release_step(cache, slot):
             # zero one slot's cursor so a freed slot stops paying
@@ -262,6 +331,55 @@ class ServingEngine:
                     "engine the fault would be silently dropped")
             self.cache, toks = self.decode_compiled(*args)
         return np.asarray(toks)
+
+    def verify(self, tokens: np.ndarray, drafts: np.ndarray,
+               temperatures: np.ndarray,
+               active: Optional[np.ndarray] = None,
+               poison: Optional[np.ndarray] = None):
+        """One speculative verify step for every slot: ``tokens
+        (max_seqs,)`` are each slot's last emitted token, ``drafts
+        (max_seqs, speculate_k)`` the draft-source proposals after it.
+        Returns ``(out_tokens (max_seqs, speculate_k + 1), counts
+        (max_seqs,))`` — slot ``s`` emits ``out_tokens[s, :counts[s]]``
+        this step (``counts`` is 0 for inactive slots, otherwise
+        ``accepted_drafts + 1``), and its cursor has already advanced by
+        exactly ``counts[s]``: rejected rows sit above the cursor where
+        no read masks them in, so retiring the slot at ANY point leaves
+        no drafted-but-rejected KV visible. Consumes and replaces the
+        donated cache; requires ``speculate_k > 0`` at construction.
+
+        ``poison`` follows the :meth:`decode` quarantine contract — on a
+        quarantine engine :attr:`last_finite` carries the per-slot
+        finite flags of the VERIFY logits afterwards."""
+        if self.verify_compiled is None:
+            raise ValueError(
+                "verify requires a speculative engine "
+                f"({type(self).__name__}(..., speculate_k=k) with k > 0)")
+        if active is None:
+            active = np.ones(self.max_seqs, np.bool_)
+        drafts = np.asarray(drafts, np.int32).reshape(
+            self.max_seqs, self.speculate_k)
+        tok_mat = np.concatenate(
+            [np.asarray(tokens, np.int32).reshape(self.max_seqs, 1),
+             drafts], axis=1)
+        args = (self.params, self.cache, jnp.asarray(tok_mat),
+                jnp.asarray(drafts),
+                jnp.asarray(temperatures, jnp.float32),
+                jnp.asarray(active, jnp.bool_), self._next_key())
+        if self.quarantine:
+            pvec = self._zero_poison if poison is None else \
+                jnp.asarray(poison, jnp.float32)
+            self.cache, toks, counts, finite = self.verify_compiled(
+                *args, pvec)
+            self.last_finite = np.asarray(finite)
+        else:
+            if poison is not None:
+                raise ValueError(
+                    "poison injection requires a quarantine engine "
+                    "(ServingEngine(..., quarantine=True)) — on a plain "
+                    "engine the fault would be silently dropped")
+            self.cache, toks, counts = self.verify_compiled(*args)
+        return np.asarray(toks), np.asarray(counts)
 
     def release_slot(self, slot: int) -> None:
         """Zero ``slot``'s write cursor (AOT-compiled, donated like the
@@ -401,7 +519,8 @@ class PagedServingEngine(ServingEngine):
                  cache_dtype=jnp.bfloat16, top_k: int = 0,
                  rng_seed: int = 0, quarantine: bool = False,
                  prefix_suffix_cap: Optional[int] = None,
-                 mean_context: Optional[float] = None):
+                 mean_context: Optional[float] = None,
+                 speculate_k: int = 0):
         model._require_cacheable()
         cfg = model.cfg
         if max_len > cfg.max_position_embeddings:
@@ -425,6 +544,13 @@ class PagedServingEngine(ServingEngine):
         self.num_blocks = int(num_blocks)
         self.top_k = int(top_k)
         self.quarantine = bool(quarantine)
+        self.speculate_k = int(speculate_k)
+        if self.speculate_k < 0:
+            raise ValueError(f"speculate_k must be >= 0, got {speculate_k}")
+        if self.speculate_k + 1 > max_len:
+            raise ValueError(
+                f"speculate_k {speculate_k} needs a {speculate_k + 1}-token "
+                f"verify window, which exceeds max_len {max_len}")
         self.prefix_suffix_cap = int(block_size if prefix_suffix_cap
                                      is None else prefix_suffix_cap)
         self.mean_context = mean_context
@@ -512,6 +638,69 @@ class PagedServingEngine(ServingEngine):
         self.decode_traced = jax.jit(
             decode_step, donate_argnums=(1,)).trace(*decode_args)
         self.decode_compiled = self.decode_traced.lower().compile()
+
+        self.verify_traced = None
+        self.verify_compiled = None
+        if self.speculate_k > 0:
+            K = self.speculate_k
+
+            def _verify_core(params, cache, tables, lengths, tokens,
+                             drafts, temperature, active, block_ids,
+                             offsets, cow_src, cow_dst, rng, poison=None):
+                # COW resolution happens inside verify_forward (before
+                # any read), exactly like the decode leg; the append
+                # targets every row of the window — rejected rows land
+                # in blocks above the host cursor mirror, which only
+                # ever advances by the accepted count
+                logits, (k_new, v_new), cache = model.verify_forward(
+                    params, tokens, cache, block_tables=tables,
+                    lengths=lengths, cow_src=cow_src, cow_dst=cow_dst,
+                    mean_context=mc)
+                finite = None
+                if poison is not None:
+                    logits = logits + poison[:, None, None]
+                    finite = jnp.all(jnp.isfinite(logits), axis=(-2, -1))
+                toks, accepted = verify_tokens(logits, drafts, rng,
+                                               temperature, self.top_k)
+                counts = jnp.where(active, accepted + 1, 0)
+                cache = cache.append_k(k_new, v_new, block_ids, offsets)
+                if finite is not None:
+                    return cache, toks, counts, finite
+                return cache, toks, counts
+
+            if self.quarantine:
+                def verify_step(params, cache, tables, lengths, tokens,
+                                drafts, temperature, active, block_ids,
+                                offsets, cow_src, cow_dst, rng, poison):
+                    with jax.named_scope("serve_verify"):
+                        return _verify_core(params, cache, tables,
+                                            lengths, tokens, drafts,
+                                            temperature, active,
+                                            block_ids, offsets, cow_src,
+                                            cow_dst, rng, poison)
+            else:
+                def verify_step(params, cache, tables, lengths, tokens,
+                                drafts, temperature, active, block_ids,
+                                offsets, cow_src, cow_dst, rng):
+                    with jax.named_scope("serve_verify"):
+                        return _verify_core(params, cache, tables,
+                                            lengths, tokens, drafts,
+                                            temperature, active,
+                                            block_ids, offsets, cow_src,
+                                            cow_dst, rng)
+
+            zq = jnp.zeros((S, K + 1), jnp.int32)
+            verify_args = (params, self.cache,
+                           jnp.zeros((S, blocks_per_slot), jnp.int32),
+                           zs, zq, jnp.zeros((S, K), jnp.int32),
+                           jnp.zeros((S,), jnp.float32),
+                           jnp.ones((S,), jnp.bool_), zq, zq, zs, zs,
+                           self._key)
+            if self.quarantine:
+                verify_args += (self._zero_poison,)
+            self.verify_traced = jax.jit(
+                verify_step, donate_argnums=(1,)).trace(*verify_args)
+            self.verify_compiled = self.verify_traced.lower().compile()
 
         def release_step(cache):
             # re-zero the reserved null block: every masked write
@@ -648,6 +837,66 @@ class PagedServingEngine(ServingEngine):
             self.cache, toks = self.decode_compiled(*args)
         self.allocator.advance(list(np.flatnonzero(ok)))
         return np.asarray(toks)
+
+    def verify(self, tokens: np.ndarray, drafts: np.ndarray,
+               temperatures: np.ndarray,
+               active: Optional[np.ndarray] = None,
+               poison: Optional[np.ndarray] = None):
+        """Paged speculative verify (same call contract as
+        :meth:`ServingEngine.verify`). Per-window block bookkeeping
+        happens HERE: :meth:`~apex_tpu.serving.cache.BlockAllocator.
+        prepare_verify` makes every block the ``speculate_k + 1``-token
+        window touches slot-private and writable (COW resolved, fresh
+        blocks mapped, atomic per slot), slots the exhausted pool could
+        not fully serve land in :attr:`last_failed` (their window aims
+        at the null block and their count comes back 0 — the scheduler
+        retires them loudly), and the cursor mirror advances by each
+        surviving slot's ACCEPTED count only."""
+        if self.verify_compiled is None:
+            raise ValueError(
+                "verify requires a speculative engine "
+                f"({type(self).__name__}(..., speculate_k=k) with k > 0)")
+        Q = self.speculate_k + 1
+        if active is None:
+            active = np.ones(self.max_seqs, np.bool_)
+        active = np.asarray(active, bool)
+        step = self.allocator.prepare_verify(
+            list(np.flatnonzero(active)), Q)
+        self.last_failed = list(step.failed)
+        ok = active.copy()
+        ok[step.failed] = False
+        block_ids, offsets = self.allocator.verify_targets(ok, Q)
+        drafts = np.asarray(drafts, np.int32).reshape(
+            self.max_seqs, self.speculate_k)
+        tok_mat = np.concatenate(
+            [np.asarray(tokens, np.int32).reshape(self.max_seqs, 1),
+             drafts], axis=1)
+        args = (self.params, self.cache,
+                jnp.asarray(self.allocator.tables),
+                jnp.asarray(self.allocator.lengths),
+                jnp.asarray(tok_mat), jnp.asarray(drafts),
+                jnp.asarray(temperatures, jnp.float32),
+                jnp.asarray(ok), jnp.asarray(block_ids),
+                jnp.asarray(offsets), jnp.asarray(step.cow_src),
+                jnp.asarray(step.cow_dst), self._next_key())
+        if self.quarantine:
+            pvec = self._zero_poison if poison is None else \
+                jnp.asarray(poison, jnp.float32)
+            self.cache, toks, counts, finite = self.verify_compiled(
+                *args, pvec)
+            self.last_finite = np.asarray(finite)
+        else:
+            if poison is not None:
+                raise ValueError(
+                    "poison injection requires a quarantine engine "
+                    "(PagedServingEngine(..., quarantine=True)) — on a "
+                    "plain engine the fault would be silently dropped")
+            self.cache, toks, counts = self.verify_compiled(*args)
+        counts = np.asarray(counts)
+        okidx = np.flatnonzero(ok)
+        self.allocator.advance_counts(
+            list(okidx), [int(counts[s]) for s in okidx])
+        return np.asarray(toks), counts
 
     def release_slot(self, slot: int) -> None:
         """Retire ``slot``: drop its block references on the host

@@ -7,6 +7,14 @@ compile-time constant (``top_k``). Greedy decoding is temperature 0 —
 selected per slot with a ``where``, not a branch — so one compiled
 program serves any mix of greedy and stochastic requests in the same
 batch, and admitting a request never recompiles.
+
+:func:`verify_tokens` is the speculative-decoding acceptance rule, traced
+into the AOT ``verify`` program: greedy slots accept a draft iff it IS
+the argmax (exact prefix match — the spec stream is bitwise the non-spec
+stream), stochastic slots run standard rejection sampling against the
+deterministic draft proposal with the corrected residual distribution,
+which makes the output distribution EXACTLY the model's (docs/SERVING.md
+"Speculative decoding" carries the two-line proof).
 """
 
 from __future__ import annotations
@@ -14,11 +22,18 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["sample_tokens"]
+__all__ = ["sample_tokens", "verify_tokens"]
 
 # temperatures at or below this sample greedily (exact argmax, not a
 # division by epsilon — the where keeps logits/0 out of the graph)
 _GREEDY_EPS = 1e-6
+
+
+def _mask_top_k(logits: jnp.ndarray, top_k: int) -> jnp.ndarray:
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return logits
 
 
 def sample_tokens(logits: jnp.ndarray, rng: jax.Array,
@@ -31,13 +46,74 @@ def sample_tokens(logits: jnp.ndarray, rng: jax.Array,
     before sampling (``top_k=1`` is exactly greedy). Returns ``(S,)``
     int32.
     """
-    logits = logits.astype(jnp.float32)
-    if top_k > 0:
-        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    logits = _mask_top_k(logits.astype(jnp.float32), top_k)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     temperature = jnp.asarray(temperature, jnp.float32)
     safe_t = jnp.maximum(temperature, _GREEDY_EPS)[:, None]
     sampled = jax.random.categorical(rng, logits / safe_t,
                                      axis=-1).astype(jnp.int32)
     return jnp.where(temperature <= _GREEDY_EPS, greedy, sampled)
+
+
+def verify_tokens(logits: jnp.ndarray, drafts: jnp.ndarray, rng: jax.Array,
+                  temperature: jnp.ndarray, top_k: int = 0):
+    """Speculative verification over ``logits (S, Q, vocab)`` — row i is
+    the model's next-token distribution AFTER in-flight token i (the
+    last accepted token at i == 0, then the ``Q - 1`` drafts) — against
+    ``drafts (S, Q-1)`` int32 from the (deterministic) draft source.
+
+    Per slot, position i < Q-1 proposes ``drafts[:, i]``:
+
+    - greedy (``temperature <= 0``): accept iff the draft IS the argmax;
+      the emitted token is the argmax either way, so the stream is
+      bitwise-identical to non-speculative greedy;
+    - stochastic: accept with probability ``P_i(draft)`` (rejection
+      sampling against a point-mass proposal); on rejection emit a
+      sample of the corrected residual — ``P_i`` with the draft's mass
+      zeroed and renormalized — which makes the marginal of the emitted
+      token exactly ``P_i``. Temperature and ``top_k`` shape ``P_i``
+      exactly as :func:`sample_tokens` does.
+
+    Row Q-1 has no draft to check: it is the bonus token, a plain
+    :func:`sample_tokens` draw from the last verified position.
+
+    Returns ``(tokens (S, Q) int32, accepted (S,) int32)``: slot ``s``
+    emits ``tokens[s, :accepted[s] + 1]`` this step — the accepted
+    drafts, then the first correction (or the bonus). Callers gate
+    inactive slots themselves.
+    """
+    S, Q, _ = logits.shape
+    logits = _mask_top_k(logits.astype(jnp.float32), top_k)
+    temperature = jnp.asarray(temperature, jnp.float32)
+    greedy_slot = (temperature <= _GREEDY_EPS)[:, None]          # (S, 1)
+    safe_t = jnp.maximum(temperature, _GREEDY_EPS)[:, None, None]
+    scaled = logits / safe_t
+
+    argmax = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # (S, Q)
+    r_acc, r_res, r_bonus = jax.random.split(rng, 3)
+
+    head = scaled[:, :-1]                                        # (S, Q-1, V)
+    # P_i(draft): softmax mass of the proposed token under the model
+    p_draft = jnp.take_along_axis(
+        jax.nn.softmax(head, axis=-1), drafts[..., None],
+        axis=-1)[..., 0]                                         # (S, Q-1)
+    u = jax.random.uniform(r_acc, drafts.shape)
+    accept_stoch = u < p_draft
+    accept_greedy = argmax[:, :-1] == drafts
+    accept = jnp.where(greedy_slot, accept_greedy, accept_stoch)
+
+    # corrected residual: the model distribution with the rejected
+    # draft's mass removed — emitted only on rejection, so the marginal
+    # stays exactly the model's
+    vocab_iota = jax.lax.broadcasted_iota(jnp.int32, head.shape, 2)
+    residual = jnp.where(vocab_iota == drafts[..., None], -jnp.inf, head)
+    res_tok = jax.random.categorical(r_res, residual,
+                                     axis=-1).astype(jnp.int32)
+    head_tok = jnp.where(greedy_slot, argmax[:, :-1],
+                         jnp.where(accept, drafts, res_tok))
+
+    bonus = sample_tokens(logits[:, -1], r_bonus, temperature, top_k=0)
+    tokens = jnp.concatenate([head_tok, bonus[:, None]], axis=1)
+    accepted = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
+                       axis=1)
+    return tokens, accepted

@@ -44,6 +44,17 @@ recompiles; ``fault_plan=`` scripts deterministic serving chaos
 (:class:`~apex_tpu.elastic.faults.FaultPlan` ``poison_logits`` /
 ``slow_decode_s``). All host-side: every feature off leaves the three
 AOT programs byte-identical (``tests/test_resilience.py``).
+
+**Speculative decoding** (docs/SERVING.md "Speculative decoding"):
+``speculate_k=k`` drives the engine's AOT ``verify`` program instead of
+``decode`` — a host-side :class:`DraftSource` (default
+:class:`NGramDraftSource`, prompt-lookup self-drafting, zero compiles)
+proposes ``k`` tokens per active slot, one program dispatch scores the
+whole window against the cached prefix, and each slot emits its
+accepted prefix plus one correction/bonus token — 1 to ``k + 1`` tokens
+per step. Greedy slots emit streams bitwise-identical to non-speculative
+greedy; the ``serve/spec_*`` metric family tracks the acceptance rate
+that decides whether ``k`` pays.
 """
 
 from __future__ import annotations
@@ -61,7 +72,55 @@ from apex_tpu.observability.reqtrace import (LATENCY_BUCKETS_MS,
 from apex_tpu.serving.cache import PoolExhausted
 from apex_tpu.serving.resilience import Rejection
 
-__all__ = ["Request", "Completion", "SlotScheduler"]
+__all__ = ["Request", "Completion", "SlotScheduler", "DraftSource",
+           "NGramDraftSource"]
+
+
+class DraftSource:
+    """Interface a speculative draft proposer implements: given a slot's
+    full token context (prompt + everything generated so far), propose
+    the next ``k`` tokens. Runs on the HOST between steps — a draft
+    source never touches the compiled programs, so swapping sources (or
+    later, backing one with a small draft model) is free of recompiles.
+    Drafts are a pure throughput hint: a wrong draft costs its slot the
+    rejected rows' compute, never correctness (the verify step's
+    acceptance rule guarantees the output distribution)."""
+
+    def draft(self, context: Sequence[int], k: int) -> List[int]:
+        """Return exactly ``k`` proposed tokens to follow ``context``
+        (``context`` is never empty — the prompt admitted)."""
+        raise NotImplementedError
+
+
+class NGramDraftSource(DraftSource):
+    """Prompt-lookup / n-gram self-drafting (the zero-model draft
+    source): find the longest suffix of the context — up to
+    ``max_ngram`` tokens — that also occurred EARLIER in the context,
+    and propose the ``k`` tokens that followed its most recent earlier
+    occurrence (padded by repeating the last proposal when the match
+    sits near the end). No match falls back to repeating the last
+    context token. Repetitive text (code, templated prose, retrieval
+    contexts) accepts most of these drafts; adversarially random text
+    accepts few — the ``serve/spec_accept_rate`` gauge is the knob
+    watcher."""
+
+    def __init__(self, max_ngram: int = 3):
+        if max_ngram < 1:
+            raise ValueError(f"max_ngram must be >= 1, got {max_ngram}")
+        self.max_ngram = int(max_ngram)
+
+    def draft(self, context: Sequence[int], k: int) -> List[int]:
+        ctx = [int(t) for t in context]
+        n = len(ctx)
+        for m in range(min(self.max_ngram, n - 1), 0, -1):
+            suffix = ctx[n - m:]
+            for start in range(n - m - 1, -1, -1):
+                if ctx[start:start + m] == suffix:
+                    out = ctx[start + m:start + m + k]
+                    while len(out) < k:
+                        out.append(out[-1])
+                    return out
+        return [ctx[-1]] * k
 
 
 @dataclasses.dataclass
@@ -134,14 +193,37 @@ class SlotScheduler:
     .BrownoutPolicy`), ``fault_plan`` (a :class:`~apex_tpu.elastic
     .faults.FaultPlan` with serving faults — a poison plan requires a
     quarantine engine and is refused here otherwise), ``dump_dir``
-    (where poison-quarantine CrashDumps land)."""
+    (where poison-quarantine CrashDumps land).
+
+    ``speculate_k=k`` (with an engine built ``speculate_k=k`` — the
+    static window must agree) switches the loop onto the engine's AOT
+    ``verify`` program: ``draft_source`` (default
+    :class:`NGramDraftSource`) proposes ``k`` tokens per slot on the
+    host, one dispatch verifies them all, and slots emit 1 to ``k + 1``
+    tokens per step. Every other knob composes unchanged — deadlines and
+    quarantine can retire a slot mid-harvest (the cursor only ever
+    advanced by the accepted count, so nothing needs rolling back) and
+    paged pool exhaustion retires the starved slot loudly."""
 
     def __init__(self, engine, registry=None, trace=None, slo=None, *,
                  max_queue: Optional[int] = None,
                  default_deadline_ms: Optional[float] = None,
-                 brownout=None, fault_plan=None, dump_dir: str = "."):
+                 brownout=None, fault_plan=None, dump_dir: str = ".",
+                 speculate_k: int = 0,
+                 draft_source: Optional[DraftSource] = None):
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if speculate_k:
+            if getattr(engine, "speculate_k", 0) != speculate_k:
+                raise ValueError(
+                    f"speculate_k={speculate_k} but the engine compiled "
+                    f"speculate_k={getattr(engine, 'speculate_k', 0)} — "
+                    "the verify program's window is static, so the "
+                    "scheduler and engine must agree at construction")
+        elif draft_source is not None:
+            raise ValueError(
+                "draft_source without speculate_k — pass speculate_k=k "
+                "(matching the engine's) to enable speculative decoding")
         if default_deadline_ms is not None and default_deadline_ms <= 0:
             raise ValueError("default_deadline_ms must be positive, "
                              f"got {default_deadline_ms}")
@@ -161,6 +243,11 @@ class SlotScheduler:
         self.brownout = brownout
         self.fault_plan = fault_plan
         self.dump_dir = dump_dir
+        self.speculate_k = int(speculate_k)
+        self.draft_source = draft_source if draft_source is not None \
+            else (NGramDraftSource() if speculate_k else None)
+        self._spec_drafted = 0
+        self._spec_accepted = 0
         self.queue: collections.deque = collections.deque()
         self.free: List[int] = list(range(engine.max_seqs))[::-1]
         self.active: Dict[int, _Active] = {}
@@ -425,6 +512,18 @@ class SlotScheduler:
         if reason is not None:
             self._retire(slot, reason, now)
 
+    def _build_drafts(self) -> np.ndarray:
+        """The host drafting pass: one :meth:`DraftSource.draft` call
+        per active slot over its full context (prompt + generated).
+        Free slots draft zeros — their verify rows are masked inactive
+        and their counts come back 0."""
+        drafts = np.zeros((self.engine.max_seqs, self.speculate_k),
+                          np.int32)
+        for slot, st in self.active.items():
+            ctx = list(st.request.prompt) + st.generated
+            drafts[slot] = self.draft_source.draft(ctx, self.speculate_k)
+        return drafts
+
     def _admit(self) -> int:
         admitted = 0
         while self.queue and self.free:
@@ -532,8 +631,14 @@ class SlotScheduler:
                         poison[pslot] = np.nan
                 mask = np.zeros(self.engine.max_seqs, np.bool_)
                 mask[list(self.active)] = True
-                nxt = self.engine.decode(self._tokens, self._temps, mask,
-                                         poison=poison)
+                counts = None
+                if self.speculate_k:
+                    nxt, counts = self.engine.verify(
+                        self._tokens, self._build_drafts(), self._temps,
+                        mask, poison=poison)
+                else:
+                    nxt = self.engine.decode(self._tokens, self._temps,
+                                             mask, poison=poison)
                 self.steps = step_idx
                 self._reg.counter("serve/decode_steps").inc()
                 finite = (self.engine.last_finite
@@ -542,7 +647,13 @@ class SlotScheduler:
                 # the fetched tokens) — the per-transition overhead
                 # contract
                 now = time.perf_counter()
+                if counts is not None:
+                    self._reg.counter("serve/spec_steps").inc()
+                    drafted = int(mask.sum()) * self.speculate_k
+                    self._spec_drafted += drafted
+                    self._reg.counter("serve/spec_drafted").inc(drafted)
                 # snapshot: _record may retire and free slots mid-harvest
+                accepted = 0
                 for slot in list(self.active):
                     if finite is not None and not finite[slot]:
                         # the poison-slot quarantine: retire ONLY this
@@ -550,13 +661,40 @@ class SlotScheduler:
                         # is discarded, every neighbor harvests normally
                         self._quarantine(slot, now)
                         continue
-                    self._record(int(nxt[slot]), self.active[slot], slot,
-                                 now, is_tick=True)
+                    if counts is None:
+                        self._record(int(nxt[slot]), self.active[slot],
+                                     slot, now, is_tick=True)
+                        continue
+                    # speculative harvest: the accepted prefix plus one
+                    # correction/bonus token. The engine already advanced
+                    # this slot's cursor by EXACTLY counts[slot], so a
+                    # retirement mid-harvest (eos / length / capacity)
+                    # abandons only tokens whose KV sits above the
+                    # cursor — a re-admitted slot can never read a
+                    # drafted-but-rejected entry
+                    accepted += max(0, int(counts[slot]) - 1)
+                    st = self.active[slot]
+                    for j in range(int(counts[slot])):
+                        self._record(int(nxt[slot, j]), st, slot, now,
+                                     is_tick=True)
+                        if slot not in self.active:
+                            break
+                if counts is not None:
+                    self._spec_accepted += accepted
+                    if accepted:
+                        self._reg.counter("serve/spec_accepted").inc(
+                            accepted)
+                    if self._spec_drafted:
+                        self._reg.gauge("serve/spec_accept_rate").set(
+                            self._spec_accepted / self._spec_drafted)
                 # paged engines: slots the exhausted pool could not
                 # give a write block retire loudly as "capacity" — this
                 # step's sampled token is valid (the kernel merges the
                 # current token in-flight) but its KV was dropped, so
-                # one more step would decode against a hole
+                # one more step would decode against a hole. On the
+                # speculative path a failed slot's window aimed at the
+                # null block and its count came back 0, so it emitted
+                # nothing this step before retiring
                 for slot in getattr(self.engine, "last_failed", ()):
                     if slot in self.active:
                         self._retire(slot, "capacity", now)

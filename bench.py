@@ -74,6 +74,11 @@ Other configs:
              the cold prefill it skips); engine config is the
              declarative ``BENCH_DECODE_CONFIGS`` table
              (docs/SERVING.md "Paged serving");
+  spec     — speculative decoding: ``gpt_decode_tok_per_sec_spec``, a
+             same-session A/B of the scheduler loop with and without
+             ``speculate_k`` drafting on a repetitive-text workload
+             (acceptance rate on the line; docs/SERVING.md
+             "Speculative decoding");
   fast     — the compound ``fastpath`` preset (tp_comm_overlap +
              bucketed DP + ZeRO-1 backward-interleaved apply +
              selective remat + donation) through the hybrid trainer vs
@@ -939,6 +944,16 @@ BENCH_DECODE_CONFIGS = {
         "max_seqs": 8, "max_len": 1024, "prefill_len": 128,
         "block_size": 128, "num_blocks": 65, "mean_context": 160.0,
     },
+    # the speculative A/B leg: a DENSE engine (no block keys — the
+    # static check validates it against ServingEngine.__init__), small
+    # batch where decode is deepest into the memory-bound regime and
+    # speculation's k-tokens-per-step amortization reads clearest;
+    # speculate_k >= 1 is enforced statically (k=0 would silently bench
+    # the non-speculative path against itself)
+    "gpt_decode_spec": {
+        "max_seqs": 4, "max_len": 1024, "prefill_len": 128,
+        "speculate_k": 4,
+    },
 }
 
 
@@ -1268,6 +1283,75 @@ def bench_gpt_decode_paged(iters=20, warmup=3, prefix_reps=5, hidden=768,
           shared_tokens=prefill_len - 1, reps=prefix_reps)
 
 
+def bench_gpt_decode_spec(new_tokens=48, requests=8, hidden=768,
+                          layers=12, heads=12, vocab=32768):
+    """Speculative-decoding A/B (docs/SERVING.md "Speculative
+    decoding"): the SAME GPT-small weights and request set through a
+    non-speculative dense engine and a ``speculate_k`` one
+    (``BENCH_DECODE_CONFIGS["gpt_decode_spec"]``), both driven by the
+    full scheduler loop so the number includes the host drafting cost.
+
+    - ``gpt_decode_tok_per_sec_spec``: end-to-end generated tokens per
+      second under speculation; ``vs_baseline`` is the ratio against
+      the same-session non-speculative run (> 1 means speculation
+      pays), with the non-spec rate, acceptance rate and verify-step
+      count riding the line.
+
+    Workload: repetitive text — greedy decoding of a random-weight
+    GPT settles into short repetition loops, exactly the regime
+    prompt-lookup drafting serves (real repetitive workloads: code,
+    templated prose, retrieval contexts). The win is k tokens per
+    memory-bound step at ~1 step's HBM traffic; CPU numbers compress
+    it (the XLA-fallback verify pays k× compute that a TPU hides under
+    the HBM stream — BASELINE.md carries the sandbox ratio), so read
+    the real delta off a TPU run."""
+    from apex_tpu.models import GPTConfig, GPTModel
+    from apex_tpu.observability.registry import MetricsRegistry
+    from apex_tpu.serving import Request, ServingEngine, SlotScheduler
+
+    spec = dict(BENCH_DECODE_CONFIGS["gpt_decode_spec"])
+    k = spec["speculate_k"]
+    slots, max_len = spec["max_seqs"], spec["max_len"]
+    prefill_len = spec["prefill_len"]
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                    num_layers=layers, num_attention_heads=heads,
+                    max_position_embeddings=max_len,
+                    compute_dtype=jnp.bfloat16)
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    pattern = np.random.RandomState(0).randint(
+        1, vocab, size=8).tolist()
+
+    def leg(speculate):
+        eng = ServingEngine(model, params,
+                            **{**spec, "speculate_k": speculate})
+        reg = MetricsRegistry()
+        sched = SlotScheduler(eng, registry=reg, speculate_k=speculate)
+        # warm run: first-dispatch host paths + any lazy sampling
+        # compiles, outside the timed window
+        sched.run([Request(prompt=pattern, max_new_tokens=2)])
+        reqs = [Request(prompt=(pattern * 32)[i: i + prefill_len],
+                        max_new_tokens=new_tokens)
+                for i in range(requests)]
+        t0 = time.perf_counter()
+        done = sched.run(reqs)
+        dt = time.perf_counter() - t0
+        gen = sum(len(c.tokens) for c in done.values())
+        return gen / dt, dict(reg.snapshot())
+
+    base_tps, _ = leg(0)
+    spec_tps, snap = leg(k)
+    _emit("gpt_decode_tok_per_sec_spec", spec_tps, "tokens/sec",
+          None if base_tps <= 0 else spec_tps / base_tps,
+          anchor="same_session_nonspec_ab",
+          nonspec_tok_per_sec=round(base_tps, 2),
+          accept_rate=round(snap.get("serve/spec_accept_rate", 0.0), 4),
+          spec_steps=int(snap.get("serve/spec_steps", 0)),
+          speculate_k=k, slots=slots, max_len=max_len,
+          prefill_len=prefill_len, new_tokens=new_tokens,
+          requests=requests)
+
+
 def bench_flash_long(seq=4096, b=8, h=12, d=64):
     """Long-context evidence: flash (auto 512-blocks) vs XLA attention
     fwd+bwd at seq 4096 — the regime the reference cannot reach at all
@@ -1324,14 +1408,16 @@ def main():
         # gpt_fast (two full hybrid-trainer compiles) after that, and
         # gpt_decode (two serving engines = four AOT compiles) next,
         # and gpt_decode_paged (one paged engine = three AOT compiles
-        # plus a dense twin for the modeled-HBM ratio, the newest leg)
-        # dead last so a tight budget drops the newest metrics, never
-        # the established baseline rows
+        # plus a dense twin for the modeled-HBM ratio) next, and
+        # gpt_decode_spec (two dense engines = seven AOT compiles for
+        # the speculative A/B, the newest leg) dead last so a tight
+        # budget drops the newest metrics, never the established
+        # baseline rows
         for fn in (bench_layernorm, bench_optimizer, bench_gpt,
                    bench_flash_long, bench_dp_accumulate_overlap,
                    bench_gpt_sp_overlap, bench_gpt_remat,
                    bench_gpt_fast, bench_gpt_decode,
-                   bench_gpt_decode_paged):
+                   bench_gpt_decode_paged, bench_gpt_decode_spec):
             if time.perf_counter() - t0 > budget_s:
                 _emit(fn.__name__, -1.0, "skipped", None,
                       error="config budget exhausted; headline protected")

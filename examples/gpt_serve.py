@@ -53,6 +53,13 @@ def main(argv=None):
                     help="default per-request deadline: requests expire "
                          "(finish_reason 'expired') while queued or "
                          "mid-flight once this budget elapses")
+    ap.add_argument("--speculate-k", type=int, default=0,
+                    help="speculative decoding: draft k tokens per slot "
+                         "from the self-drafting n-gram source and "
+                         "verify them in ONE step (docs/SERVING.md "
+                         "'Speculative decoding'); prints the "
+                         "acceptance rate and the TPOT delta against a "
+                         "same-session non-speculative baseline")
     ap.add_argument("--ttft-slo-ms", type=float, default=5000.0,
                     help="demo SLO: TTFT p95 threshold")
     ap.add_argument("--tpot-slo-ms", type=float, default=1000.0,
@@ -69,7 +76,8 @@ def main(argv=None):
     engine = ServingEngine(
         model, params, max_seqs=args.max_seqs, max_len=args.max_len,
         prefill_len=args.prefill_len, top_k=args.top_k,
-        cache_dtype=jnp.int8 if args.int8_cache else jnp.bfloat16)
+        cache_dtype=jnp.int8 if args.int8_cache else jnp.bfloat16,
+        speculate_k=args.speculate_k)
     print(f"engine: {args.max_seqs} slots x {args.max_len} tokens, "
           f"{engine.bytes_per_slot()} cache bytes/slot; a 16GB chip "
           f"would hold ~{engine.suggest_max_seqs(16 << 30)} slots")
@@ -82,16 +90,22 @@ def main(argv=None):
                      on_violation="skip")
     sched = SlotScheduler(engine, registry=reg, trace=trace, slo=slo,
                           max_queue=args.max_queue,
-                          default_deadline_ms=args.deadline_ms)
-    rng = np.random.RandomState(0)
+                          default_deadline_ms=args.deadline_ms,
+                          speculate_k=args.speculate_k)
+
+    def demo_requests():
+        rng = np.random.RandomState(0)
+        return [Request(prompt=rng.randint(
+                            1, args.vocab,
+                            size=1 + i % args.prefill_len).tolist(),
+                        max_new_tokens=1 + (args.max_new_tokens
+                                            * (i + 1)) // 2,
+                        temperature=0.0 if i % 2 == 0 else 0.8)
+                for i in range(args.requests)]
+
     rejections = []
-    for i in range(args.requests):
-        prompt = rng.randint(1, args.vocab,
-                             size=1 + i % args.prefill_len).tolist()
-        res = sched.submit(Request(prompt=prompt,
-                                   max_new_tokens=1 + (args.max_new_tokens
-                                                       * (i + 1)) // 2,
-                                   temperature=0.0 if i % 2 == 0 else 0.8))
+    for i, req in enumerate(demo_requests()):
+        res = sched.submit(req)
         if isinstance(res, Rejection):
             rejections.append(res)
             print(f"  req {i} rejected: {res.reason} ({res.detail})")
@@ -166,10 +180,40 @@ def main(argv=None):
         trace.write_chrome_trace(args.trace_out)
         print(f"chrome request trace ({len(trace)} records, one lane "
               f"per slot) -> {args.trace_out}")
+    spec = None
+    if args.speculate_k:
+        # same-session A/B: the identical request mix on a
+        # non-speculative engine gives the honest TPOT baseline (the
+        # repetitive loops a greedy tiny model falls into are exactly
+        # what the n-gram source predicts)
+        base_engine = ServingEngine(
+            model, params, max_seqs=args.max_seqs, max_len=args.max_len,
+            prefill_len=args.prefill_len, top_k=args.top_k,
+            cache_dtype=jnp.int8 if args.int8_cache else jnp.bfloat16)
+        base_reg = MetricsRegistry()
+        SlotScheduler(base_engine, registry=base_reg).run(demo_requests())
+        base_tpot = base_reg.histogram(
+            "serve/tpot_ms", LATENCY_BUCKETS_MS).percentile(50)
+        accept = full_snap.get("serve/spec_accept_rate", 0.0)
+        spec = {"k": args.speculate_k,
+                "accept_rate": accept,
+                "drafted": int(full_snap.get("serve/spec_drafted", 0)),
+                "accepted": int(full_snap.get("serve/spec_accepted", 0)),
+                "spec_steps": int(full_snap.get("serve/spec_steps", 0)),
+                "tpot_p50_ms": latency["tpot_p50_ms"],
+                "baseline_tpot_p50_ms": round(base_tpot, 2),
+                "tpot_delta_ms": round(base_tpot
+                                       - latency["tpot_p50_ms"], 2)}
+        print(f"speculative: k={spec['k']}, accepted "
+              f"{spec['accepted']}/{spec['drafted']} drafts "
+              f"(rate {accept:.3f}) over {spec['spec_steps']} verify "
+              f"steps; tpot p50 {spec['tpot_p50_ms']:.2f}ms vs "
+              f"{spec['baseline_tpot_p50_ms']:.2f}ms non-speculative "
+              f"(delta {spec['tpot_delta_ms']:+.2f}ms)")
     return {"completions": results, "metrics": snap, "latency": latency,
             "goodput": goodput, "slo": [t.describe() for t in targets],
             "rejected": rejected, "expired": expired,
-            "rejections": rejections}
+            "rejections": rejections, "spec": spec}
 
 
 if __name__ == "__main__":

@@ -63,11 +63,12 @@ def test_ast_rule_clean_on_this_tree(name):
 
 
 def test_annotation_contract_size():
-    """The table doubles as the pyprof region vocabulary: 19 contract
-    entries as of PR 9 (4 original + bucketed allreduce + optimizer_step
-    + 8 model phases + 2 tp layers + 3 serving regions)."""
+    """The table doubles as the pyprof region vocabulary: 20 contract
+    entries as of PR 20 (4 original + bucketed allreduce + optimizer_step
+    + 8 model phases + 2 tp layers + 4 serving regions incl.
+    serve_verify)."""
     _, notes = rule_annotations(REPO)
-    assert len(notes) == len(ANNOTATIONS) == 19
+    assert len(notes) == len(ANNOTATIONS) == 20
 
 
 # ---------------------------------------------------------------------------

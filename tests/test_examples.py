@@ -158,6 +158,36 @@ def test_gpt_serve_resilience_flags():
                for c in payload["completions"].values())
 
 
+def test_gpt_serve_speculative_flag():
+    """--speculate-k serves the same request mix through the verify
+    program and prints the acceptance rate plus the TPOT delta against
+    a same-session non-speculative baseline (docs/SERVING.md
+    "Speculative decoding"). Greedy requests must complete with their
+    exact lengths — speculation changes the stepping, never the
+    stream."""
+    import gpt_serve
+    payload = gpt_serve.main(["--requests", "4", "--max-new-tokens", "6",
+                              "--speculate-k", "3"])
+    results = payload["completions"]
+    assert sorted(results) == list(range(4))
+    for i, c in sorted(results.items()):
+        assert len(c.tokens) == 1 + (6 * (i + 1)) // 2
+        assert c.finish_reason == "length"
+    spec = payload["spec"]
+    assert spec["k"] == 3
+    assert 0.0 <= spec["accept_rate"] <= 1.0
+    assert spec["drafted"] > 0 and spec["spec_steps"] > 0
+    assert spec["accepted"] == round(spec["accept_rate"]
+                                     * spec["drafted"])
+    # the A/B carries both TPOT medians and their delta
+    assert spec["tpot_p50_ms"] > 0.0 and spec["baseline_tpot_p50_ms"] > 0.0
+    assert spec["tpot_delta_ms"] == round(
+        spec["baseline_tpot_p50_ms"] - spec["tpot_p50_ms"], 2)
+    # without the flag the payload says so explicitly
+    assert gpt_serve.main(["--requests", "2",
+                           "--max-new-tokens", "2"])["spec"] is None
+
+
 def test_dcgan_amp_runs():
     import dcgan_amp
     errD, errG = dcgan_amp.main(["--steps", "3", "--batch", "8"])

@@ -217,7 +217,8 @@ class TestFleetPublisher:
 
 def _rank_snapshot(run_dir, rank, step, steps_counter, wall_ms=None,
                    health=None, pool_free=None, cow_copies=None,
-                   pool_used=None, pool_util=None):
+                   pool_used=None, pool_util=None, spec_drafted=None,
+                   spec_accepted=None, spec_rate=None):
     reg = MetricsRegistry()
     reg.counter("train/steps").inc(steps_counter)
     if wall_ms is not None:
@@ -230,6 +231,12 @@ def _rank_snapshot(run_dir, rank, step, steps_counter, wall_ms=None,
         reg.gauge("serve/pool_blocks_used").set(pool_used)
     if pool_util is not None:
         reg.gauge("serve/pool_utilization").set(pool_util)
+    if spec_drafted is not None:
+        reg.counter("serve/spec_drafted").inc(spec_drafted)
+    if spec_accepted is not None:
+        reg.counter("serve/spec_accepted").inc(spec_accepted)
+    if spec_rate is not None:
+        reg.gauge("serve/spec_accept_rate").set(spec_rate)
     pub = FleetPublisher(run_dir, rank=rank, registry=reg)
     if health:
         pub(step, health)
@@ -268,10 +275,14 @@ class TestFleetAggregator:
         run = str(tmp_path)
         _rank_snapshot(run, 0, step=2, steps_counter=2,
                        pool_free=40.0, cow_copies=1,
-                       pool_used=23.0, pool_util=23.0 / 63.0)
+                       pool_used=23.0, pool_util=23.0 / 63.0,
+                       spec_drafted=40, spec_accepted=30,
+                       spec_rate=0.75)
         _rank_snapshot(run, 1, step=2, steps_counter=2,
                        pool_free=20.0, cow_copies=2,
-                       pool_used=43.0, pool_util=43.0 / 63.0)
+                       pool_used=43.0, pool_util=43.0 / 63.0,
+                       spec_drafted=40, spec_accepted=10,
+                       spec_rate=0.25)
         sup = MetricsRegistry()
         sup.gauge("elastic/world_size").set(2)
         sup.counter("elastic/restarts").inc()
@@ -284,6 +295,11 @@ class TestFleetAggregator:
         assert snap["serve/blocks_cow_copied"] == 3.0
         assert snap["serve/pool_blocks_used"] == 33.0
         assert abs(snap["serve/pool_utilization"] - 33.0 / 63.0) < 1e-9
+        # speculative-decoding surface: the draft/accept counters sum
+        # across ranks, the acceptance-rate gauge lands as the mean
+        assert snap["serve/spec_drafted"] == 80.0
+        assert snap["serve/spec_accepted"] == 40.0
+        assert abs(snap["serve/spec_accept_rate"] - 0.5) < 1e-9
         assert snap["elastic/world_size"] == 2.0
         assert snap["elastic/restarts"] == 1.0
         text = merged.render_prometheus()
